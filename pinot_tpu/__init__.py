@@ -1,0 +1,31 @@
+"""pinot_tpu — a TPU-native real-time OLAP framework.
+
+A ground-up re-design of Apache Pinot's capability set (reference surveyed in
+/root/repo/SURVEY.md) for TPU hardware: immutable columnar segments pinned in
+HBM as JAX device arrays, filter->project->aggregate compiled as jax.jit/Pallas
+kernels, per-segment combine as psum/shard_map collectives over ICI, and the
+surrounding system (stream ingestion, upsert, indexes, SQL, multi-stage joins,
+cluster control plane) rebuilt idiomatically.
+
+Layer map (mirrors SURVEY.md section 1, re-architected):
+  spi/       - schema, table config, column types        (pinot-spi analog)
+  segment/   - columnar segment format, build/load       (pinot-segment-* analog)
+  indexes/   - inverted/range/bloom/star-tree/...        (index SPI analog)
+  query/     - IR, planner, jit kernels, executor        (pinot-core SSE analog)
+  sql/       - SQL parser -> IR                          (CalciteSqlParser analog)
+  parallel/  - device mesh, shard_map combine            (scatter-gather analog)
+  realtime/  - mutable segments, stream consumption      (realtime analog)
+  mse/       - multi-stage engine: joins, exchanges      (pinot-query-* analog)
+  cluster/   - coordinator, broker, server roles         (controller/broker/server)
+"""
+
+# OLAP semantics require 64-bit LONG/DOUBLE (Pinot aggregates into long/double;
+# golden tests compare against 64-bit sqlite). Hot-path code arrays stay int32/
+# uint8/16; only reductions widen.  Must run before any jax array creation.
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from pinot_tpu.spi.schema import DataType, FieldSpec, FieldRole, Schema  # noqa: E402,F401

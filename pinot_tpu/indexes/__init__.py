@@ -1,0 +1,29 @@
+"""Pluggable index registry (StandardIndexes analog,
+pinot-segment-spi/.../spi/index/StandardIndexes.java:73-157).
+
+Each index kind implements: build(...), to_regions(prefix), meta(),
+from_regions(meta, regions, prefix); segments persist them inside the single
+columns.bin (store.py) and reload via load_index."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from pinot_tpu.indexes.bloom import BloomFilter
+from pinot_tpu.indexes.inverted import InvertedIndex, RangeEncodedIndex
+
+_REGISTRY = {
+    InvertedIndex.KIND: InvertedIndex,
+    RangeEncodedIndex.KIND: RangeEncodedIndex,
+    BloomFilter.KIND: BloomFilter,
+}
+
+
+def register_index(kind: str, cls) -> None:
+    _REGISTRY[kind] = cls
+
+
+def load_index(kind: str, meta: Dict[str, Any], regions, prefix: str):
+    cls = _REGISTRY.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown index kind {kind!r} (have {list(_REGISTRY)})")
+    return cls.from_regions(meta, regions, prefix)

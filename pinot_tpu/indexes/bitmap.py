@@ -1,0 +1,49 @@
+"""Dense bitmask utilities — the TPU replacement for RoaringBitmap.
+
+Reference parity: RoaringBitmap underpins Pinot's inverted/range/json/null
+indexes and filter algebra (SURVEY.md 2.4).  On TPU, compressed sparse bitmaps
+are hostile to vector units; dense uint32 word tensors are native: AND/OR/NOT
+are elementwise ops, cardinality is a popcount-reduce, and doc masks unpack
+with shifts.  Layout: bit j of word w == doc (w*32 + j), LSB-first.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+WORD_BITS = 32
+
+
+def num_words(num_docs: int) -> int:
+    return (num_docs + WORD_BITS - 1) // WORD_BITS
+
+
+def pack_mask(mask: np.ndarray) -> np.ndarray:
+    """bool[n] -> uint32[ceil(n/32)] (host side, build time)."""
+    n = len(mask)
+    bits = np.packbits(np.asarray(mask, dtype=bool), bitorder="little")
+    pad = (-len(bits)) % 4
+    if pad:
+        bits = np.concatenate([bits, np.zeros(pad, dtype=np.uint8)])
+    return bits.view(np.uint32).copy()
+
+
+def unpack_mask(words: np.ndarray, n: int) -> np.ndarray:
+    """uint32[w] -> bool[n] (host side)."""
+    return np.unpackbits(np.asarray(words, dtype=np.uint32).view(np.uint8), bitorder="little", count=n).astype(bool)
+
+
+def unpack_mask_device(words, n: int):
+    """uint32[w] -> bool[n] on device: shift-and-mask, static shapes."""
+    import jax.numpy as jnp
+
+    w = words.shape[0]
+    bits = (words[:, None] >> jnp.arange(WORD_BITS, dtype=words.dtype)[None, :]) & 1
+    return bits.reshape(w * WORD_BITS)[:n].astype(bool)
+
+
+def popcount_device(words):
+    """Total set bits of a uint32 word tensor (device)."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    return jnp.sum(lax.population_count(words).astype(jnp.int32))

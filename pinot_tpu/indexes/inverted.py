@@ -1,0 +1,134 @@
+"""Inverted + range-encoded bitmap indexes as dense HBM tensors.
+
+Reference parity:
+  * Inverted: dictId -> bitmap of docIds (pinot-segment-local
+    BitmapInvertedIndexReader; creator in .../segment/creator/impl/inv/).
+  * Range: bucketed ranges -> bitmaps answering >, <, BETWEEN
+    (RangeIndexReader + RangeIndexBasedFilterOperator).
+
+TPU re-design: both become one 2-D uint32 bitmask tensor.
+  * InvertedIndex: rows = per-dictId doc bitmaps, shape (card, words).
+    EQ(v) = one row load (n/8 bytes instead of n..4n for a code scan);
+    IN(set) = OR of k rows.
+  * RangeEncodedIndex: rows = PREFIX bitmaps, prefix[i] = docs with code < i,
+    shape (card+1, words).  range[lo,hi) = prefix[hi] AND NOT prefix[lo] —
+    two row loads for ANY range width (better than Pinot's bucket scheme,
+    which still scans bucket interiors).  EQ also derivable, so a column with
+    a range index doesn't need a separate inverted index.
+
+Only built for cardinality <= threshold (builder default 64k rows of words):
+for high-cardinality columns a vectorized code scan is already HBM-optimal on
+TPU, matching Pinot's own guidance that inverted indexes pay off on
+low-cardinality filter columns.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from pinot_tpu.indexes.bitmap import num_words, WORD_BITS
+
+
+def _bitmaps_from_codes(codes: np.ndarray, cardinality: int, num_docs: int) -> np.ndarray:
+    """Build (cardinality, words) doc bitmaps from the code array in one
+    vectorized pass (the off-heap creator analog)."""
+    words = num_words(num_docs)
+    out = np.zeros((cardinality, words), dtype=np.uint32)
+    docs = np.arange(num_docs, dtype=np.int64)
+    w = docs >> 5
+    bit = np.uint32(1) << (docs & 31).astype(np.uint32)
+    # scatter-OR per (code, word); np.bitwise_or.at handles duplicates.
+    np.bitwise_or.at(out, (codes.astype(np.int64), w), bit)
+    return out
+
+
+class InvertedIndex:
+    """Per-dictId doc bitmaps: shape (cardinality, words)."""
+
+    KIND = "inverted"
+
+    def __init__(self, bitmaps: np.ndarray, num_docs: int):
+        self.bitmaps = bitmaps
+        self.num_docs = num_docs
+        self._device = None
+
+    @staticmethod
+    def build(codes: np.ndarray, cardinality: int, num_docs: int) -> "InvertedIndex":
+        return InvertedIndex(_bitmaps_from_codes(codes, cardinality, num_docs), num_docs)
+
+    @property
+    def cardinality(self) -> int:
+        return self.bitmaps.shape[0]
+
+    def device(self, device=None):
+        if self._device is None:
+            import jax
+
+            self._device = jax.device_put(self.bitmaps, device)
+        return self._device
+
+    # host-side eval (tests / host executor)
+    def doc_bitmap(self, dict_ids) -> np.ndarray:
+        rows = self.bitmaps[np.asarray(dict_ids, dtype=np.int64)]
+        return np.bitwise_or.reduce(rows, axis=0) if rows.ndim == 2 else rows
+
+    # serde
+    def to_regions(self, prefix: str):
+        yield f"{prefix}.bitmaps", self.bitmaps
+
+    def meta(self) -> Dict[str, Any]:
+        return {"numDocs": self.num_docs, "cardinality": int(self.bitmaps.shape[0])}
+
+    @staticmethod
+    def from_regions(meta: Dict[str, Any], regions, prefix: str) -> "InvertedIndex":
+        return InvertedIndex(np.asarray(regions[f"{prefix}.bitmaps"]), meta["numDocs"])
+
+
+class RangeEncodedIndex:
+    """Prefix bitmaps: prefix[i] = docs with code < i; shape (card+1, words).
+
+    range [lo, hi) = prefix[hi] & ~prefix[lo] (prefix[lo] subset of
+    prefix[hi]), i.e. two row loads per range predicate."""
+
+    KIND = "range"
+
+    def __init__(self, prefix: np.ndarray, num_docs: int):
+        self.prefix = prefix
+        self.num_docs = num_docs
+        self._device = None
+
+    @staticmethod
+    def build(codes: np.ndarray, cardinality: int, num_docs: int) -> "RangeEncodedIndex":
+        per_value = _bitmaps_from_codes(codes, cardinality, num_docs)
+        prefix = np.zeros((cardinality + 1, per_value.shape[1]), dtype=np.uint32)
+        np.bitwise_or.accumulate(per_value, axis=0, out=per_value)
+        prefix[1:] = per_value
+        return RangeEncodedIndex(prefix, num_docs)
+
+    @property
+    def cardinality(self) -> int:
+        return self.prefix.shape[0] - 1
+
+    def device(self, device=None):
+        if self._device is None:
+            import jax
+
+            self._device = jax.device_put(self.prefix, device)
+        return self._device
+
+    def range_bitmap(self, lo: int, hi: int) -> np.ndarray:
+        """Docs with lo <= code < hi (host side)."""
+        lo = max(0, min(lo, self.cardinality))
+        hi = max(lo, min(hi, self.cardinality))
+        return self.prefix[hi] & ~self.prefix[lo]
+
+    def to_regions(self, prefix: str):
+        yield f"{prefix}.prefix", self.prefix
+
+    def meta(self) -> Dict[str, Any]:
+        return {"numDocs": self.num_docs, "cardinality": int(self.prefix.shape[0] - 1)}
+
+    @staticmethod
+    def from_regions(meta: Dict[str, Any], regions, prefix: str) -> "RangeEncodedIndex":
+        return RangeEncodedIndex(np.asarray(regions[f"{prefix}.prefix"]), meta["numDocs"])

@@ -1,0 +1,147 @@
+"""Sorted per-column value dictionaries.
+
+Reference parity: pinot-segment-local SegmentDictionaryCreator + the typed
+readers (pinot-segment-spi Dictionary.java:38 — indexOf/insertionIndexOf/get*).
+The dictionary is SORTED, which is the load-bearing trick the TPU build keeps:
+range predicates on a dict-encoded column become closed-form dictId-range
+compares on the code array (no value gather needed on device).
+
+Design deltas vs the reference:
+  * One implementation for all types over numpy (object array for strings).
+  * encode() is vectorized (np.searchsorted) — the whole column at once.
+  * Numeric dictionaries can be shipped to HBM (values array) so projection of
+    a dict-encoded numeric column is a device-side gather; string dictionaries
+    stay host-side and the device only ever sees int32 codes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from pinot_tpu.spi.schema import DataType
+
+# Sentinel dictId for "value not in dictionary" (Dictionary.NULL_VALUE_INDEX).
+NULL_DICT_ID = -1
+
+
+def min_code_dtype(cardinality: int) -> np.dtype:
+    """Smallest unsigned dtype that holds [0, cardinality) codes.
+
+    This is the TPU answer to Pinot's fixed-bit packing
+    (FixedBitSVForwardIndexReaderV2): byte-aligned widths mmap and DMA with
+    zero unpack cost; sub-byte packing is a later Pallas optimization."""
+    if cardinality <= 1 << 8:
+        return np.dtype(np.uint8)
+    if cardinality <= 1 << 16:
+        return np.dtype(np.uint16)
+    return np.dtype(np.uint32)
+
+
+@dataclass
+class Dictionary:
+    """Immutable sorted dictionary for one column."""
+
+    data_type: DataType
+    values: np.ndarray  # sorted ascending; dtype = data_type.np_dtype (object for strings)
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.values)
+
+    @property
+    def code_dtype(self) -> np.dtype:
+        return min_code_dtype(self.cardinality)
+
+    # -- build -----------------------------------------------------------
+    @staticmethod
+    def build(data_type: DataType, raw_values: np.ndarray) -> Tuple["Dictionary", np.ndarray]:
+        """One pass: sorted unique values + codes for every row.
+
+        Collapses Pinot's two-phase flow (stats collector -> dictionary
+        creator -> per-row indexOf) into np.unique(return_inverse), which is
+        exactly 'sort unique + searchsorted' fused."""
+        if data_type.is_string_like:
+            # np.unique on object arrays works for str; for bytes too.
+            values, inverse = np.unique(np.asarray(raw_values, dtype=object), return_inverse=True)
+        else:
+            arr = np.asarray(raw_values, dtype=data_type.np_dtype)
+            values, inverse = np.unique(arr, return_inverse=True)
+        d = Dictionary(data_type=data_type, values=values)
+        return d, inverse.astype(np.int32)
+
+    # -- lookups ---------------------------------------------------------
+    def index_of(self, value) -> int:
+        """Exact-match dictId or NULL_DICT_ID (Dictionary.indexOf)."""
+        i = int(np.searchsorted(self.values, self._coerce(value)))
+        if i < len(self.values) and self.values[i] == self._coerce(value):
+            return i
+        return NULL_DICT_ID
+
+    def insertion_index_of(self, value) -> int:
+        """Bisect-left index; callers use it to turn range predicates into
+        dictId ranges (Dictionary.insertionIndexOf semantics: -(pos)-1 when
+        absent).  We return the plain insertion point plus a found flag via
+        index_of; range translation lives in query/predicates.py."""
+        return int(np.searchsorted(self.values, self._coerce(value)))
+
+    def encode(self, raw_values: np.ndarray) -> np.ndarray:
+        """Vectorized value->code; raises on unknown values."""
+        if self.data_type.is_string_like:
+            arr = np.asarray(raw_values, dtype=object)
+        else:
+            arr = np.asarray(raw_values, dtype=self.data_type.np_dtype)
+        codes = np.searchsorted(self.values, arr)
+        codes = np.clip(codes, 0, len(self.values) - 1)
+        if not (self.values[codes] == arr).all():
+            bad = arr[self.values[codes] != arr]
+            raise ValueError(f"values not in dictionary: {bad[:5]!r}")
+        return codes.astype(np.int32)
+
+    def get_values(self, dict_ids: np.ndarray) -> np.ndarray:
+        return self.values[np.asarray(dict_ids)]
+
+    def _coerce(self, value):
+        if self.data_type.is_string_like:
+            return value
+        return self.data_type.np_dtype.type(value)
+
+    # -- device ----------------------------------------------------------
+    def device_values(self) -> Optional[np.ndarray]:
+        """Numeric dictionary values for HBM residency (None for strings)."""
+        if self.data_type.is_string_like:
+            return None
+        return np.asarray(self.values, dtype=self.data_type.np_dtype)
+
+    # -- serde (store.py writes these regions) ---------------------------
+    def to_regions(self, prefix: str):
+        """Yield (name, ndarray) regions. Strings become a utf-8 blob +
+        int64 offsets — the V3-single-file analog of Pinot's var-length
+        dictionary layout."""
+        if self.data_type.is_string_like:
+            if self.data_type is DataType.BYTES:
+                encoded = [bytes(v) for v in self.values]
+            else:
+                encoded = [str(v).encode("utf-8") for v in self.values]
+            blob = b"".join(encoded)
+            offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+            np.cumsum([len(e) for e in encoded], out=offsets[1:])
+            yield f"{prefix}.dict.blob", np.frombuffer(blob, dtype=np.uint8)
+            yield f"{prefix}.dict.offsets", offsets
+        else:
+            yield f"{prefix}.dict.values", np.asarray(self.values)
+
+    @staticmethod
+    def from_regions(data_type: DataType, regions, prefix: str) -> "Dictionary":
+        if data_type.is_string_like:
+            blob = regions[f"{prefix}.dict.blob"].tobytes()
+            offsets = regions[f"{prefix}.dict.offsets"]
+            if data_type is DataType.BYTES:
+                vals = [blob[offsets[i]: offsets[i + 1]] for i in range(len(offsets) - 1)]
+            else:
+                vals = [blob[offsets[i]: offsets[i + 1]].decode("utf-8") for i in range(len(offsets) - 1)]
+            values = np.asarray(vals, dtype=object)
+        else:
+            values = np.asarray(regions[f"{prefix}.dict.values"], dtype=data_type.np_dtype)
+        return Dictionary(data_type=data_type, values=values)

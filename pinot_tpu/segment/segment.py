@@ -1,0 +1,200 @@
+"""Immutable segment: the unit of storage, distribution and query.
+
+Reference parity: pinot-segment-spi IndexSegment/ImmutableSegment and
+pinot-segment-local ImmutableSegmentImpl + ImmutableSegmentLoader.load
+(ImmutableSegmentLoader.java:91) — a named, immutable, columnar slice of a
+table with per-column metadata, dictionaries, forward storage and optional
+extra indexes.
+
+TPU re-design (SURVEY.md section 7 "Segment = pytree of device arrays"):
+  * Host side: zero-copy mmaps over columns.bin (store.py).
+  * Device side: `to_device()` pins a plain-dict pytree of jnp arrays in HBM —
+    {col: {"codes": u8/u16/u32[n]} | {"values": dtype[n]}, plus "dict" for
+    numeric dictionaries and "nulls" for null masks}.  Static facts
+    (num_docs, cardinalities, stats) stay host-side for pruning and for
+    building closed-form predicate constants, so jitted kernels see only
+    dense arrays and static shapes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.segment import store
+from pinot_tpu.segment.dictionary import Dictionary, min_code_dtype
+from pinot_tpu.segment.stats import ColumnStats
+from pinot_tpu.spi.schema import DataType, Schema
+
+
+@dataclass
+class ColumnData:
+    """One column inside a segment (DataSource analog: forward index +
+    dictionary + null vector handles)."""
+
+    name: str
+    data_type: DataType
+    dictionary: Optional[Dictionary]  # None => raw storage
+    codes: Optional[np.ndarray]  # uint8/16/32[num_docs] when dict-encoded
+    values: Optional[np.ndarray]  # raw storage (numeric) when no dictionary
+    nulls: Optional[np.ndarray]  # bool[num_docs] true=null, None if no nulls
+    stats: ColumnStats
+
+    @property
+    def has_dictionary(self) -> bool:
+        return self.dictionary is not None
+
+    @property
+    def cardinality(self) -> int:
+        return self.dictionary.cardinality if self.dictionary else self.stats.cardinality
+
+    def decoded(self) -> np.ndarray:
+        """Materialize raw values host-side (tests/golden comparisons)."""
+        if self.dictionary is not None:
+            return self.dictionary.get_values(self.codes)
+        return self.values
+
+
+class ImmutableSegment:
+    """Loaded immutable segment with optional device residency."""
+
+    def __init__(
+        self,
+        name: str,
+        table_name: str,
+        schema: Schema,
+        columns: Dict[str, ColumnData],
+        num_docs: int,
+        indexes: Optional[Dict[str, Dict[str, Any]]] = None,
+        creation_time_ms: int = 0,
+        time_range: Optional[tuple] = None,
+    ):
+        self.name = name
+        self.table_name = table_name
+        self.schema = schema
+        self.columns = columns
+        self.num_docs = num_docs
+        # indexes[kind][column] -> index object (indexes/ package), e.g.
+        # indexes["inverted"]["color"] -> BitmapInvertedIndex
+        self.indexes: Dict[str, Dict[str, Any]] = indexes or {}
+        self.creation_time_ms = creation_time_ms
+        self.time_range = time_range  # (min, max) of the table's time column
+        self._device_cache: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------------
+    def column(self, name: str) -> ColumnData:
+        try:
+            return self.columns[name]
+        except KeyError:
+            raise KeyError(f"segment {self.name} has no column {name!r}") from None
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self.columns)
+
+    # -- device residency ----------------------------------------------
+    def to_device(self, device=None, columns: Optional[List[str]] = None) -> Dict[str, Any]:
+        """Pin column arrays into device memory; returns the segment pytree.
+
+        The pytree is cached — segments are immutable so repeated queries hit
+        HBM-resident arrays (the AcquireReleaseColumnsSegment analog is the
+        residency manager in query/executor.py)."""
+        import jax
+
+        if self._device_cache is not None and columns is None:
+            return self._device_cache
+        cols = columns or list(self.columns)
+        out: Dict[str, Any] = {}
+        for cname in cols:
+            c = self.columns[cname]
+            entry: Dict[str, Any] = {}
+            if c.codes is not None:
+                entry["codes"] = jax.device_put(np.asarray(c.codes), device)
+                dvals = c.dictionary.device_values() if c.dictionary else None
+                if dvals is not None:
+                    entry["dict"] = jax.device_put(dvals, device)
+            if c.values is not None:
+                entry["values"] = jax.device_put(np.asarray(c.values), device)
+            if c.nulls is not None:
+                entry["nulls"] = jax.device_put(np.asarray(c.nulls), device)
+            out[cname] = entry
+        if columns is None:
+            self._device_cache = out
+        return out
+
+    def release_device(self) -> None:
+        self._device_cache = None
+
+    # -- persistence ----------------------------------------------------
+    def save(self, path: str) -> None:
+        regions = []
+        col_meta = []
+        for c in self.columns.values():
+            if c.dictionary is not None:
+                regions.extend(c.dictionary.to_regions(c.name))
+                regions.append((f"{c.name}.fwd", c.codes))
+            else:
+                regions.append((f"{c.name}.fwd", c.values))
+            if c.nulls is not None:
+                regions.append((f"{c.name}.nulls", np.packbits(c.nulls)))
+            col_meta.append(
+                {
+                    "stats": c.stats.to_dict(),
+                    "hasNulls": c.nulls is not None,
+                }
+            )
+        for kind, by_col in self.indexes.items():
+            for cname, idx in by_col.items():
+                regions.extend(idx.to_regions(f"{cname}.{kind}"))
+        meta = {
+            "segmentName": self.name,
+            "tableName": self.table_name,
+            "numDocs": self.num_docs,
+            "schema": self.schema.to_dict(),
+            "columns": col_meta,
+            "indexes": {kind: {c: idx.meta() for c, idx in by_col.items()} for kind, by_col in self.indexes.items()},
+            "creationTimeMs": self.creation_time_ms,
+            "timeRange": [v.item() if isinstance(v, np.generic) else v for v in self.time_range]
+            if self.time_range
+            else None,
+        }
+        store.write_segment(path, meta, regions)
+
+    @staticmethod
+    def load(path: str) -> "ImmutableSegment":
+        """mmap-load (ImmutableSegmentLoader.load analog — ReadMode.mmap)."""
+        from pinot_tpu.indexes import load_index  # local import; avoids cycle
+
+        meta, regions = store.read_segment(path)
+        schema = Schema.from_dict(meta["schema"])
+        num_docs = meta["numDocs"]
+        columns: Dict[str, ColumnData] = {}
+        for cm in meta["columns"]:
+            stats = ColumnStats.from_dict(cm["stats"])
+            name = stats.name
+            dt = stats.data_type
+            nulls = None
+            if cm.get("hasNulls"):
+                nulls = np.unpackbits(np.asarray(regions[f"{name}.nulls"]), count=num_docs).astype(bool)
+            if stats.has_dictionary:
+                dictionary = Dictionary.from_regions(dt, regions, name)
+                codes = regions[f"{name}.fwd"]
+                columns[name] = ColumnData(name, dt, dictionary, codes, None, nulls, stats)
+            else:
+                columns[name] = ColumnData(name, dt, None, None, regions[f"{name}.fwd"], nulls, stats)
+        indexes: Dict[str, Dict[str, Any]] = {}
+        for kind, by_col in meta.get("indexes", {}).items():
+            for cname, idx_meta in by_col.items():
+                idx = load_index(kind, idx_meta, regions, f"{cname}.{kind}")
+                indexes.setdefault(kind, {})[cname] = idx
+        return ImmutableSegment(
+            name=meta["segmentName"],
+            table_name=meta["tableName"],
+            schema=schema,
+            columns=columns,
+            num_docs=num_docs,
+            indexes=indexes,
+            creation_time_ms=meta.get("creationTimeMs", 0),
+            time_range=tuple(meta["timeRange"]) if meta.get("timeRange") else None,
+        )

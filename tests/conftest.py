@@ -1,0 +1,20 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax import.
+
+This is the reference's "N logical nodes in one JVM" trick (SURVEY.md section
+4.5) in TPU form: multi-chip sharding paths run against
+xla_force_host_platform_device_count=8 so tests exercise real Mesh/shard_map
+code without TPU hardware."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

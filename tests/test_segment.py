@@ -1,0 +1,195 @@
+"""M0 tests: dictionary, bitmap utils, indexes, segment build/save/load
+round-trips — the index reader/writer unit-test tier of the reference
+(SURVEY.md section 4.1)."""
+import numpy as np
+import pytest
+
+from pinot_tpu.indexes.bitmap import pack_mask, unpack_mask, unpack_mask_device
+from pinot_tpu.indexes.bloom import BloomFilter
+from pinot_tpu.indexes.inverted import InvertedIndex, RangeEncodedIndex
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.segment.dictionary import Dictionary, NULL_DICT_ID, min_code_dtype
+from pinot_tpu.segment.segment import ImmutableSegment
+from pinot_tpu.spi.config import IndexingConfig, TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+
+def make_schema():
+    return Schema(
+        name="t",
+        fields=[
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("year", DataType.INT),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+            FieldSpec("runs", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("score", DataType.DOUBLE, role=FieldRole.METRIC, nullable=True),
+        ],
+    )
+
+
+def make_data(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "city": rng.choice(["sf", "nyc", "chi", "la", "sea"], n).astype(object),
+        "year": rng.integers(1990, 2024, n).astype(np.int32),
+        "ts": rng.integers(1_500_000_000_000, 1_700_000_000_000, n),
+        "runs": rng.integers(0, 100, n),
+        "score": np.where(rng.random(n) < 0.1, np.nan, rng.random(n) * 10),
+    }
+
+
+class TestDictionary:
+    def test_sorted_and_roundtrip_int(self):
+        vals = np.array([5, 3, 5, 9, 3, 1], dtype=np.int32)
+        d, codes = Dictionary.build(DataType.INT, vals)
+        assert list(d.values) == [1, 3, 5, 9]
+        np.testing.assert_array_equal(d.get_values(codes), vals)
+
+    def test_sorted_and_roundtrip_string(self):
+        vals = np.array(["b", "a", "c", "a"], dtype=object)
+        d, codes = Dictionary.build(DataType.STRING, vals)
+        assert list(d.values) == ["a", "b", "c"]
+        np.testing.assert_array_equal(d.get_values(codes), vals)
+
+    def test_index_of(self):
+        d, _ = Dictionary.build(DataType.INT, np.array([10, 20, 30]))
+        assert d.index_of(20) == 1
+        assert d.index_of(25) == NULL_DICT_ID
+        assert d.insertion_index_of(25) == 2
+
+    def test_encode_rejects_unknown(self):
+        d, _ = Dictionary.build(DataType.INT, np.array([10, 20]))
+        with pytest.raises(ValueError):
+            d.encode(np.array([10, 99]))
+
+    def test_min_code_dtype(self):
+        assert min_code_dtype(200) == np.uint8
+        assert min_code_dtype(60000) == np.uint16
+        assert min_code_dtype(70000) == np.uint32
+
+
+class TestBitmap:
+    def test_pack_unpack(self, rng):
+        mask = rng.random(1000) < 0.3
+        words = pack_mask(mask)
+        np.testing.assert_array_equal(unpack_mask(words, 1000), mask)
+
+    def test_unpack_device(self, rng):
+        import jax
+
+        mask = rng.random(100) < 0.5
+        words = pack_mask(mask)
+        out = np.asarray(unpack_mask_device(jax.numpy.asarray(words), 100))
+        np.testing.assert_array_equal(out, mask)
+
+
+class TestIndexes:
+    def test_inverted(self, rng):
+        n, card = 500, 7
+        codes = rng.integers(0, card, n).astype(np.int32)
+        idx = InvertedIndex.build(codes, card, n)
+        for v in range(card):
+            np.testing.assert_array_equal(unpack_mask(idx.doc_bitmap([v]), n), codes == v)
+        got = unpack_mask(idx.doc_bitmap([1, 3]), n)
+        np.testing.assert_array_equal(got, (codes == 1) | (codes == 3))
+
+    def test_range_encoded(self, rng):
+        n, card = 500, 50
+        codes = rng.integers(0, card, n).astype(np.int32)
+        idx = RangeEncodedIndex.build(codes, card, n)
+        for lo, hi in [(0, 50), (10, 20), (5, 5), (49, 50), (0, 1)]:
+            np.testing.assert_array_equal(
+                unpack_mask(idx.range_bitmap(lo, hi), n), (codes >= lo) & (codes < hi)
+            )
+
+    def test_bloom(self):
+        bf = BloomFilter.build(["a", "b", "c", 42])
+        assert bf.might_contain("a") and bf.might_contain(42)
+        false_hits = sum(bf.might_contain(f"zz{i}") for i in range(200))
+        assert false_hits < 30
+
+
+class TestSegmentBuild:
+    def test_build_and_stats(self):
+        schema, data = make_schema(), make_data()
+        seg = build_segment(schema, data, "seg0")
+        assert seg.num_docs == 1000
+        c = seg.column("year")
+        assert c.has_dictionary and c.codes.dtype == np.uint8
+        assert c.stats.min_value == data["year"].min()
+        assert c.stats.max_value == data["year"].max()
+        runs = seg.column("runs")
+        assert not runs.has_dictionary and runs.values.dtype == np.int64
+        score = seg.column("score")
+        assert score.nulls is not None and score.nulls.sum() > 0
+        np.testing.assert_array_equal(seg.column("city").decoded(), data["city"])
+
+    def test_sorted_column(self):
+        schema, data = make_schema(), make_data()
+        cfg = TableConfig(name="t", indexing=IndexingConfig(sorted_column="year"))
+        seg = build_segment(schema, data, "seg0", table_config=cfg)
+        decoded = seg.column("year").decoded()
+        assert (decoded[:-1] <= decoded[1:]).all()
+        assert seg.column("year").stats.is_sorted
+        # other columns permuted consistently: (year, runs) pairs preserved
+        pairs = sorted(zip(data["year"].tolist(), data["runs"].tolist()))
+        got = sorted(zip(decoded.tolist(), seg.column("runs").values.tolist()))
+        assert pairs == got
+
+    def test_save_load_roundtrip(self, tmp_path):
+        schema, data = make_schema(), make_data()
+        cfg = TableConfig(
+            name="t",
+            indexing=IndexingConfig(
+                inverted_index_columns=["city"],
+                range_index_columns=["year"],
+                bloom_filter_columns=["city"],
+            ),
+        )
+        seg = build_segment(schema, data, "seg0", table_config=cfg, output_dir=str(tmp_path / "seg0"))
+        loaded = ImmutableSegment.load(str(tmp_path / "seg0"))
+        assert loaded.num_docs == seg.num_docs
+        assert loaded.schema.column_names == schema.column_names
+        for name in schema.column_names:
+            a, b = seg.column(name), loaded.column(name)
+            np.testing.assert_array_equal(a.decoded(), b.decoded())
+            assert a.stats.to_dict() == b.stats.to_dict()
+            if a.nulls is not None:
+                np.testing.assert_array_equal(a.nulls, b.nulls)
+        inv = loaded.indexes["inverted"]["city"]
+        np.testing.assert_array_equal(inv.bitmaps, seg.indexes["inverted"]["city"].bitmaps)
+        rng_idx = loaded.indexes["range"]["year"]
+        np.testing.assert_array_equal(rng_idx.prefix, seg.indexes["range"]["year"].prefix)
+        assert loaded.indexes["bloom"]["city"].might_contain("sf")
+
+    def test_to_device(self):
+        schema, data = make_schema(), make_data(100)
+        seg = build_segment(schema, data, "seg0")
+        dev = seg.to_device()
+        assert "codes" in dev["city"] and "dict" not in dev["city"]  # string dict host-side
+        assert "codes" in dev["year"] and "dict" in dev["year"]
+        assert "values" in dev["runs"]
+        np.testing.assert_array_equal(np.asarray(dev["year"]["dict"])[np.asarray(dev["year"]["codes"])],
+                                      data["year"])
+
+    def test_nullable_object_column(self):
+        schema = Schema("t", [FieldSpec("s", DataType.STRING, nullable=True)])
+        seg = build_segment(schema, {"s": ["a", None, "b"]}, "s0")
+        assert seg.column("s").nulls.tolist() == [False, True, False]
+
+
+class TestSchemaSerde:
+    def test_roundtrip(self):
+        s = make_schema()
+        s2 = Schema.from_json(s.to_json())
+        assert s2.to_dict() == s.to_dict()
+
+    def test_table_config_roundtrip(self):
+        cfg = TableConfig(
+            name="t",
+            indexing=IndexingConfig(inverted_index_columns=["a"], sorted_column="b"),
+            partition_column="a",
+            num_partitions=8,
+        )
+        cfg2 = TableConfig.from_json(cfg.to_json())
+        assert cfg2.to_dict() == cfg.to_dict()

@@ -1,0 +1,72 @@
+"""In-process query engine: table registry + execute = broker+server in one.
+
+Reference parity: this is the BaseQueriesTest topology (SURVEY.md 4.2) as a
+production object — real planner + executor + reduce, no cluster required.
+The cluster layer (cluster/) wraps the same engine behind broker/server
+roles; the distributed combine (parallel/) slots in between execute and
+reduce.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from pinot_tpu.query import executor, reduce as reduce_mod
+from pinot_tpu.query.ir import QueryContext
+from pinot_tpu.query.result import ExecutionStats, ResultTable
+from pinot_tpu.segment.segment import ImmutableSegment
+from pinot_tpu.spi.config import TableConfig
+from pinot_tpu.spi.schema import Schema
+
+
+@dataclass
+class TableState:
+    schema: Schema
+    config: TableConfig
+    segments: List[ImmutableSegment] = field(default_factory=list)
+
+
+class QueryEngine:
+    def __init__(self) -> None:
+        self.tables: Dict[str, TableState] = {}
+
+    # -- table registry (controller-lite) -------------------------------
+    def register_table(self, schema: Schema, config: Optional[TableConfig] = None) -> None:
+        cfg = config or TableConfig(name=schema.name)
+        self.tables[cfg.name] = TableState(schema=schema, config=cfg)
+
+    def add_segment(self, table: str, segment: ImmutableSegment) -> None:
+        self.tables[table].segments.append(segment)
+
+    def table(self, name: str) -> TableState:
+        if name not in self.tables:
+            raise KeyError(f"table {name!r} not registered (have {list(self.tables)})")
+        return self.tables[name]
+
+    # -- execution -------------------------------------------------------
+    def execute(self, ctx: QueryContext, device=None) -> ResultTable:
+        t0 = time.perf_counter()
+        state = self.table(ctx.table)
+        stats = ExecutionStats()
+        results = []
+        for seg in state.segments:
+            stats.num_segments_queried += 1
+            stats.total_docs += seg.num_docs
+            if executor.prune_segment(ctx, seg):
+                stats.num_segments_pruned += 1
+                continue
+            res, seg_stats = executor.execute_segment(ctx, seg, device=device)
+            stats.num_segments_processed += 1
+            stats.num_docs_scanned += seg_stats.num_docs_scanned
+            results.append(res)
+        out = reduce_mod.reduce_results(ctx, results, stats)
+        out.stats.time_ms = (time.perf_counter() - t0) * 1000
+        return out
+
+    def query(self, sql: str, device=None) -> ResultTable:
+        """SQL front door (CalciteSqlParser analog lives in sql/)."""
+        from pinot_tpu.sql.parser import parse_query
+
+        ctx = parse_query(sql)
+        return self.execute(ctx, device=device)

@@ -1,0 +1,333 @@
+"""Aggregation function registry.
+
+Reference parity: pinot-core AggregationFunction contract
+(.../query/aggregation/function/AggregationFunction.java:44 — aggregate /
+aggregateGroupBySV / merge / extractFinalResult) and
+AggregationFunctionFactory.
+
+Re-design: the per-row `aggregate` loop becomes two vectorized device forms —
+`partial(values, mask)` (scalar partial over a whole segment) and
+`partial_grouped(values, mask, keys, num_groups)` (dense group table via
+segment_sum/scatter-min — the DefaultGroupByExecutor + result-holder analog).
+Partials are dicts of arrays so merge is shape-generic: AVG carries
+(sum, count), MIN carries (min, seen), etc.  All numeric aggregation is
+float64, matching Pinot's double accumulators (SumAggregationFunction et al).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Partial = Dict[str, Any]
+
+_POS_INF = float("inf")
+_NEG_INF = float("-inf")
+
+# CONTRACT: partial field NAMES imply their combine semantics.  Generic code
+# (host sparse groupby, aligned dense merges, psum combines) dispatches on the
+# field name instead of calling per-function merge() pairwise.
+#   sum/count/sumsq -> additive      min -> minimum      max -> maximum
+FIELD_COMBINE = {
+    "sum": "add",
+    "count": "add",
+    "sumsq": "add",
+    "min": "min",
+    "max": "max",
+}
+
+
+def field_identity(field_name: str) -> float:
+    op = FIELD_COMBINE[field_name]
+    return 0.0 if op == "add" else (_POS_INF if op == "min" else _NEG_INF)
+
+
+def combine_field(field_name: str, a, b):
+    op = FIELD_COMBINE[field_name]
+    if op == "add":
+        return a + b
+    if op == "min":
+        return np.minimum(a, b)
+    return np.maximum(a, b)
+
+
+class AggFunction:
+    """Base: one aggregation function's device/host contract."""
+
+    name: str = ""
+    needs_expr: bool = True
+
+    # -- device: per-segment partials -----------------------------------
+    def partial(self, values, mask) -> Partial:
+        raise NotImplementedError
+
+    def partial_grouped(self, values, mask, keys, num_groups: int) -> Partial:
+        raise NotImplementedError
+
+    # -- host or device: combine ----------------------------------------
+    def merge(self, a: Partial, b: Partial) -> Partial:
+        raise NotImplementedError
+
+    def final(self, p: Partial):
+        raise NotImplementedError
+
+    def final_dtype(self) -> np.dtype:
+        return np.dtype(np.float64)
+
+
+def _f64(values):
+    return values.astype(jnp.float64)
+
+
+def _seg_sum(vals, keys, num_groups):
+    return jax.ops.segment_sum(vals, keys, num_segments=num_groups)
+
+
+class CountFunction(AggFunction):
+    name = "count"
+    needs_expr = False  # COUNT(*) — COUNT(col) counts non-null via mask
+
+    def partial(self, values, mask):
+        return {"count": jnp.sum(mask, dtype=jnp.int64)}
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        return {"count": _seg_sum(mask.astype(jnp.int64), keys, num_groups)}
+
+    def merge(self, a, b):
+        return {"count": a["count"] + b["count"]}
+
+    def final(self, p):
+        return p["count"]
+
+    def final_dtype(self):
+        return np.dtype(np.int64)
+
+
+class SumFunction(AggFunction):
+    """Carries (sum, count) so SUM over zero matching rows is SQL NULL."""
+
+    name = "sum"
+
+    def partial(self, values, mask):
+        return {
+            "sum": jnp.sum(jnp.where(mask, _f64(values), 0.0)),
+            "count": jnp.sum(mask, dtype=jnp.int64),
+        }
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        return {
+            "sum": _seg_sum(jnp.where(mask, _f64(values), 0.0), keys, num_groups),
+            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+        }
+
+    def merge(self, a, b):
+        return {"sum": a["sum"] + b["sum"], "count": a["count"] + b["count"]}
+
+    def final(self, p):
+        return np.where(np.asarray(p["count"]) > 0, np.asarray(p["sum"], dtype=np.float64), np.nan)
+
+
+class MinFunction(AggFunction):
+    name = "min"
+
+    def partial(self, values, mask):
+        return {
+            "min": jnp.min(jnp.where(mask, _f64(values), _POS_INF)),
+            "count": jnp.sum(mask, dtype=jnp.int64),
+        }
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        v = jnp.where(mask, _f64(values), _POS_INF)
+        return {
+            "min": jnp.full((num_groups,), _POS_INF, dtype=jnp.float64).at[keys].min(v),
+            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+        }
+
+    def merge(self, a, b):
+        return {"min": np.minimum(a["min"], b["min"]), "count": a["count"] + b["count"]}
+
+    def final(self, p):
+        return np.where(np.asarray(p["count"]) > 0, np.asarray(p["min"], dtype=np.float64), np.nan)
+
+
+class MaxFunction(AggFunction):
+    name = "max"
+
+    def partial(self, values, mask):
+        return {
+            "max": jnp.max(jnp.where(mask, _f64(values), _NEG_INF)),
+            "count": jnp.sum(mask, dtype=jnp.int64),
+        }
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        v = jnp.where(mask, _f64(values), _NEG_INF)
+        return {
+            "max": jnp.full((num_groups,), _NEG_INF, dtype=jnp.float64).at[keys].max(v),
+            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+        }
+
+    def merge(self, a, b):
+        return {"max": np.maximum(a["max"], b["max"]), "count": a["count"] + b["count"]}
+
+    def final(self, p):
+        return np.where(np.asarray(p["count"]) > 0, np.asarray(p["max"], dtype=np.float64), np.nan)
+
+
+class AvgFunction(AggFunction):
+    """Carries (sum, count) — Pinot's AvgPair intermediate result."""
+
+    name = "avg"
+
+    def partial(self, values, mask):
+        return {
+            "sum": jnp.sum(jnp.where(mask, _f64(values), 0.0)),
+            "count": jnp.sum(mask, dtype=jnp.int64),
+        }
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        return {
+            "sum": _seg_sum(jnp.where(mask, _f64(values), 0.0), keys, num_groups),
+            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+        }
+
+    def merge(self, a, b):
+        return {"sum": a["sum"] + b["sum"], "count": a["count"] + b["count"]}
+
+    def final(self, p):
+        cnt = np.asarray(p["count"], dtype=np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return np.where(cnt > 0, np.asarray(p["sum"]) / cnt, np.nan)
+
+
+class MinMaxRangeFunction(AggFunction):
+    """MINMAXRANGE = max - min (Pinot MinMaxRangeAggregationFunction)."""
+
+    name = "minmaxrange"
+
+    def partial(self, values, mask):
+        v = _f64(values)
+        return {
+            "min": jnp.min(jnp.where(mask, v, _POS_INF)),
+            "max": jnp.max(jnp.where(mask, v, _NEG_INF)),
+            "count": jnp.sum(mask, dtype=jnp.int64),
+        }
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        v = _f64(values)
+        return {
+            "min": jnp.full((num_groups,), _POS_INF, dtype=jnp.float64).at[keys].min(jnp.where(mask, v, _POS_INF)),
+            "max": jnp.full((num_groups,), _NEG_INF, dtype=jnp.float64).at[keys].max(jnp.where(mask, v, _NEG_INF)),
+            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+        }
+
+    def merge(self, a, b):
+        return {
+            "min": np.minimum(a["min"], b["min"]),
+            "max": np.maximum(a["max"], b["max"]),
+            "count": a["count"] + b["count"],
+        }
+
+    def final(self, p):
+        rng = np.asarray(p["max"], dtype=np.float64) - np.asarray(p["min"], dtype=np.float64)
+        return np.where(np.asarray(p["count"]) > 0, rng, np.nan)
+
+
+class SumOfSquaresFunction(AggFunction):
+    """Building block for VARIANCE/STDDEV (Pinot VarianceAggregationFunction
+    carries count/sum/sumOfSquares the same way)."""
+
+    name = "_sumsq"
+
+    def partial(self, values, mask):
+        v = _f64(values)
+        return {
+            "count": jnp.sum(mask, dtype=jnp.int64),
+            "sum": jnp.sum(jnp.where(mask, v, 0.0)),
+            "sumsq": jnp.sum(jnp.where(mask, v * v, 0.0)),
+        }
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        v = _f64(values)
+        return {
+            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+            "sum": _seg_sum(jnp.where(mask, v, 0.0), keys, num_groups),
+            "sumsq": _seg_sum(jnp.where(mask, v * v, 0.0), keys, num_groups),
+        }
+
+    def merge(self, a, b):
+        return {k: a[k] + b[k] for k in ("count", "sum", "sumsq")}
+
+
+class VarianceFunction(SumOfSquaresFunction):
+    name = "variance"  # population variance (VAR_POP)
+
+    def final(self, p):
+        cnt = np.asarray(p["count"], dtype=np.float64)
+        s = np.asarray(p["sum"], dtype=np.float64)
+        ss = np.asarray(p["sumsq"], dtype=np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mean = s / cnt
+            return np.where(cnt > 0, ss / cnt - mean * mean, np.nan)
+
+
+class VarianceSampFunction(SumOfSquaresFunction):
+    name = "varsamp"
+
+    def final(self, p):
+        cnt = np.asarray(p["count"], dtype=np.float64)
+        s = np.asarray(p["sum"], dtype=np.float64)
+        ss = np.asarray(p["sumsq"], dtype=np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mean = s / cnt
+            return np.where(cnt > 1, (ss - cnt * mean * mean) / (cnt - 1), np.nan)
+
+
+class StdDevFunction(VarianceFunction):
+    name = "stddev"
+
+    def final(self, p):
+        return np.sqrt(super().final(p))
+
+
+class StdDevSampFunction(VarianceSampFunction):
+    name = "stddevsamp"
+
+    def final(self, p):
+        return np.sqrt(super().final(p))
+
+
+_REGISTRY: Dict[str, AggFunction] = {}
+
+
+def register(fn: AggFunction) -> None:
+    _REGISTRY[fn.name] = fn
+
+
+for _cls in (
+    CountFunction,
+    SumFunction,
+    MinFunction,
+    MaxFunction,
+    AvgFunction,
+    MinMaxRangeFunction,
+    VarianceFunction,
+    VarianceSampFunction,
+    StdDevFunction,
+    StdDevSampFunction,
+):
+    register(_cls())
+
+# aliases (Pinot exposes several)
+_REGISTRY["var_pop"] = _REGISTRY["variance"]
+_REGISTRY["var_samp"] = _REGISTRY["varsamp"]
+_REGISTRY["stddev_pop"] = _REGISTRY["stddev"]
+_REGISTRY["stddev_samp"] = _REGISTRY["stddevsamp"]
+
+
+def get_agg_function(name: str) -> AggFunction:
+    fn = _REGISTRY.get(name.lower())
+    if fn is None:
+        raise ValueError(f"unknown aggregation function {name!r} (have {sorted(_REGISTRY)})")
+    return fn

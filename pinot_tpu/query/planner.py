@@ -1,0 +1,311 @@
+"""Per-segment plan maker + jit kernel compiler — the SSE hot path.
+
+Reference parity: InstancePlanMakerImplV2.makeSegmentPlanNode
+(pinot-core/.../core/plan/maker/InstancePlanMakerImplV2.java:347-362) picking
+Aggregation/GroupBy/Selection plans per query shape, plus the operator chain
+it builds (FilterPlanNode -> DocIdSet -> Projection -> Transform ->
+Aggregation/GroupBy operators, SURVEY.md 3.1 hot loop).
+
+Re-design (SURVEY.md section 7 "Query plan = traced function"): instead of an
+interpreted operator tree pulling 10k-doc blocks, the whole
+filter->project->aggregate chain for one query shape is traced into ONE
+jax.jit kernel over whole columns; XLA fuses it. Compiled kernels are cached
+by (query fingerprint, segment signature) — the plan-cache analog — so a
+table of uniformly-shaped segments compiles once.
+
+Group-by: dictId-packed keys (DictionaryBasedGroupKeyGenerator analog,
+.../groupby/DictionaryBasedGroupKeyGenerator.java:68): the composite key is
+codes raveled over dimension cardinalities; when the cardinality product fits
+numGroupsLimit the result is a DENSE group table filled by segment_sum /
+scatter-min-max (result-holder analog). Overflow falls back to a vectorized
+host groupby (executor.py) — the IndexedTable-with-trim analog, to be
+replaced by a Pallas hash table.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pinot_tpu.query.filter import FilterCompiler
+from pinot_tpu.query.functions import AggFunction, get_agg_function
+from pinot_tpu.query.ir import AggregationSpec, Expr, QueryContext
+from pinot_tpu.query.transform import eval_expr
+from pinot_tpu.segment.segment import ImmutableSegment
+from pinot_tpu.spi.schema import DataType
+
+MAX_DENSE_RAW_INT_RANGE = 1 << 20  # raw ints join the dense keyspace when (max-min+1) is small
+
+
+@dataclass
+class GroupDim:
+    """How one group-by dimension maps into the dense key space."""
+
+    expr: Expr
+    name: str
+    kind: str  # "dict" | "rawint"
+    cardinality: int
+    dictionary: Optional[Any] = None  # Dictionary for kind=dict
+    base: int = 0  # min value for kind=rawint
+    null_code: int = -1  # code representing SQL NULL (placeholder), -1 if none
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        if self.kind == "dict":
+            vals = self.dictionary.get_values(codes)
+        else:
+            vals = codes.astype(np.int64) + self.base
+        if self.null_code >= 0:
+            vals = np.asarray(vals, dtype=object)
+            vals[np.asarray(codes) == self.null_code] = None
+        return vals
+
+
+@dataclass
+class SegmentPlan:
+    kind: str  # "aggregation" | "groupby_dense" | "groupby_sparse" | "selection"
+    fn: Callable  # jitted kernel(cols, params)
+    params: Dict[str, Any]
+    needed_columns: List[str]
+    aggs: List[AggFunction] = field(default_factory=list)
+    group_dims: List[GroupDim] = field(default_factory=list)
+    num_groups: int = 0
+    select_columns: List[str] = field(default_factory=list)
+
+
+# jit cache: (query fingerprint, segment signature) -> (fn, plan metadata)
+_PLAN_CACHE: Dict[Tuple[str, Tuple], SegmentPlan] = {}
+
+
+def plan_cache_clear() -> None:
+    _PLAN_CACHE.clear()
+
+
+def plan_cache_size() -> int:
+    return len(_PLAN_CACHE)
+
+
+def _sig_value(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def _segment_signature(segment: ImmutableSegment, needed: List[str]) -> Tuple:
+    sig = [segment.num_docs]
+    for name in sorted(needed):
+        c = segment.column(name)
+        # Raw columns include min/max: the kernel bakes rawint group-dim
+        # base/cardinality in statically, so they are part of the cache key.
+        raw_range = None
+        if not c.has_dictionary and c.data_type.is_numeric:
+            raw_range = (
+                (_sig_value(c.stats.min_value), _sig_value(c.stats.max_value)) if c.stats.num_docs else (0, 0)
+            )
+        sig.append(
+            (
+                name,
+                c.cardinality if c.has_dictionary else -1,
+                str(c.codes.dtype if c.codes is not None else c.values.dtype),
+                c.nulls is not None,
+                raw_range,
+            )
+        )
+    return tuple(sig)
+
+
+def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
+    cols: List[str] = []
+    if ctx.filter:
+        cols.extend(ctx.filter.columns())
+    for g in ctx.group_by:
+        cols.extend(g.columns())
+    for s in ctx.select_list:
+        if isinstance(s, AggregationSpec):
+            if s.expr is not None:
+                cols.extend(s.expr.columns())
+            if s.filter:
+                cols.extend(s.filter.columns())
+        else:
+            cols.extend(s.columns())
+    for o in ctx.order_by:
+        cols.extend(o.expr.columns())
+    if ctx.having:
+        cols.extend(ctx.having.columns())
+    seen, out = set(), []
+    for c in cols:
+        if c == "*":
+            for name in segment.schema.column_names:
+                if name not in seen:
+                    seen.add(name)
+                    out.append(name)
+            continue
+        if c not in seen:
+            seen.add(c)
+            out.append(c)
+    return out
+
+
+def _group_dim(expr: Expr, segment: ImmutableSegment, null_handling: bool) -> GroupDim:
+    if not expr.is_column:
+        raise NotImplementedError(f"group-by on expression {expr} not yet supported (bare columns only)")
+    c = segment.column(expr.op)
+    null_code = -1
+    if c.has_dictionary:
+        if c.nulls is not None and null_handling:
+            nc = c.dictionary.index_of(c.data_type.null_placeholder)
+            if nc >= 0:
+                null_code = nc
+        return GroupDim(expr, c.name, "dict", c.dictionary.cardinality, dictionary=c.dictionary, null_code=null_code)
+    if c.data_type in (DataType.INT, DataType.LONG, DataType.TIMESTAMP, DataType.BOOLEAN):
+        lo, hi = int(c.stats.min_value), int(c.stats.max_value)
+        rng = hi - lo + 1
+        if rng <= MAX_DENSE_RAW_INT_RANGE:
+            return GroupDim(expr, c.name, "rawint", rng, base=lo)
+    raise NotImplementedError(
+        f"group-by on raw column {c.name} ({c.data_type.value}, range too wide) requires the sparse path"
+    )
+
+
+def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
+    needed = _needed_columns(ctx, segment)
+    key = (ctx.fingerprint(), _segment_signature(segment, needed))
+    cached = _PLAN_CACHE.get(key)
+    if cached is not None:
+        # params are per-segment (dictionary-dependent): rebuild them, reuse fn
+        plan = _build_plan(ctx, segment, needed, compiled_fn=cached.fn)
+        return plan
+    plan = _build_plan(ctx, segment, needed, compiled_fn=None)
+    _PLAN_CACHE[key] = plan
+    return plan
+
+
+def _build_plan(
+    ctx: QueryContext,
+    segment: ImmutableSegment,
+    needed: List[str],
+    compiled_fn: Optional[Callable],
+) -> SegmentPlan:
+    null_handling = ctx.null_handling
+    fc = FilterCompiler(segment, null_handling)
+    filter_fn = fc.compile(ctx.filter)
+
+    aggs = [get_agg_function(a.function) for a in ctx.aggregations]
+    agg_specs = list(ctx.aggregations)
+
+    # per-aggregation FILTER(WHERE ...) clauses
+    agg_filter_fns: List[Optional[Callable]] = []
+    for spec in agg_specs:
+        agg_filter_fns.append(fc.compile(spec.filter) if spec.filter is not None else None)
+
+    if ctx.is_aggregate and not ctx.group_by:
+        kind = "aggregation"
+        group_dims: List[GroupDim] = []
+        num_groups = 0
+    elif ctx.group_by:
+        group_dims = [_group_dim(g, segment, null_handling) for g in ctx.group_by]
+        num_groups = 1
+        for gd in group_dims:
+            num_groups *= max(1, gd.cardinality)
+        kind = "groupby_dense" if num_groups <= ctx.max_dense_groups else "groupby_sparse"
+    else:
+        kind = "selection"
+        group_dims = []
+        num_groups = 0
+
+    def _agg_inputs(cols, params, base_mask):
+        """Per-aggregation (values, mask) with null + FILTER handling."""
+        out = []
+        for spec, fn, ffn in zip(agg_specs, aggs, agg_filter_fns):
+            mask = base_mask
+            if ffn is not None:
+                ft, _ = ffn(cols, params)
+                mask = mask & ft
+            if spec.expr is None:
+                vals = mask  # COUNT(*): values unused
+            elif fn.name == "count" and spec.expr.is_column:
+                # COUNT(col) needs only the null mask — works on strings too.
+                vals = mask
+                c = segment.column(spec.expr.op)
+                if c.nulls is not None and null_handling:
+                    mask = mask & ~cols[spec.expr.op]["nulls"]
+            else:
+                vals, nulls = eval_expr(spec.expr, segment, cols)
+                if nulls is not None and null_handling:
+                    mask = mask & ~nulls
+            out.append((vals, mask))
+        return out
+
+    def _group_key(cols, params):
+        key = None
+        for gd in group_dims:
+            if gd.kind == "dict":
+                code = cols[gd.name]["codes"].astype(jnp.int32)
+            else:
+                base = jnp.asarray(gd.base)
+                code = (cols[gd.name]["values"] - base).astype(jnp.int32)
+            key = code if key is None else key * np.int32(gd.cardinality) + code
+        return key
+
+    if kind == "aggregation":
+
+        def kernel(cols, params):
+            tmask, _ = filter_fn(cols, params)
+            return [fn.partial(vals, mask) for fn, (vals, mask) in zip(aggs, _agg_inputs(cols, params, tmask))]
+
+    elif kind == "groupby_dense":
+
+        def kernel(cols, params):
+            tmask, _ = filter_fn(cols, params)
+            key = _group_key(cols, params)
+            presence = jax.ops.segment_sum(tmask.astype(jnp.int32), key, num_segments=num_groups)
+            partials = [
+                fn.partial_grouped(vals, mask, key, num_groups)
+                for fn, (vals, mask) in zip(aggs, _agg_inputs(cols, params, tmask))
+            ]
+            return presence, partials
+
+    elif kind == "groupby_sparse":
+        # Device computes mask + per-dim codes + agg inputs; host finishes the
+        # groupby (executor._execute_groupby_sparse).
+        def kernel(cols, params):
+            tmask, _ = filter_fn(cols, params)
+            key = None  # codes per dim, not raveled (host packs into int64)
+            codes = []
+            for gd in group_dims:
+                if gd.kind == "dict":
+                    codes.append(cols[gd.name]["codes"].astype(jnp.int32))
+                else:
+                    codes.append((cols[gd.name]["values"] - jnp.asarray(gd.base)).astype(jnp.int32))
+            inputs = _agg_inputs(cols, params, tmask)
+            return tmask, codes, inputs
+
+    else:  # selection
+
+        def kernel(cols, params):
+            tmask, _ = filter_fn(cols, params)
+            return tmask
+
+    fn = compiled_fn if compiled_fn is not None else jax.jit(kernel)
+
+    select_columns = []
+    if kind == "selection":
+        for s in ctx.select_list:
+            if isinstance(s, Expr) and s.is_column:
+                if s.op == "*":
+                    select_columns.extend(segment.schema.column_names)
+                else:
+                    select_columns.append(s.op)
+            else:
+                raise NotImplementedError(f"selection expression {s} not yet supported (bare columns / *)")
+
+    return SegmentPlan(
+        kind=kind,
+        fn=fn,
+        params=fc.params,
+        needed_columns=needed,
+        aggs=aggs,
+        group_dims=group_dims,
+        num_groups=num_groups,
+        select_columns=select_columns,
+    )

@@ -1,0 +1,310 @@
+"""Broker reduce: merge per-segment results, HAVING/ORDER BY/LIMIT, format.
+
+Reference parity: BrokerReduceService.reduceOnDataTable
+(pinot-core/.../query/reduce/BrokerReduceService.java:65) and its per-shape
+reducers (GroupByDataTableReducer, AggregationDataTableReducer,
+SelectionDataTableReducer) + PostAggregationHandler/HAVING handling.
+
+Re-design: partials arrive as numpy arrays, not serialized DataTables.  The
+group-by merge has two paths:
+  * ALIGNED DENSE: when every segment produced a dense group table over the
+    SAME key space (shared dictionary fingerprints — always true for stacked/
+    aligned tables, M2), merging is pure elementwise array combination; this
+    is the shape that becomes a psum over ICI in the distributed engine.
+  * GENERIC: decoded-key hash merge (GroupByDataTableReducer's IndexedTable
+    analog) for heterogeneous segments.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pinot_tpu.query.functions import combine_field, get_agg_function
+from pinot_tpu.query.ir import (
+    AggregationSpec,
+    Expr,
+    FilterNode,
+    FilterOp,
+    OrderByExpr,
+    PredicateType,
+    QueryContext,
+)
+from pinot_tpu.query.result import (
+    AggSegmentResult,
+    ExecutionStats,
+    GroupBySegmentResult,
+    ResultTable,
+    SelectionSegmentResult,
+)
+
+
+def reduce_results(ctx: QueryContext, results: List[Any], stats: ExecutionStats) -> ResultTable:
+    if ctx.is_aggregate and not ctx.group_by:
+        return _reduce_aggregation(ctx, results, stats)
+    if ctx.group_by:
+        return _reduce_groupby(ctx, results, stats)
+    return _reduce_selection(ctx, results, stats)
+
+
+# ---------------------------------------------------------------------------
+# Aggregation-only
+# ---------------------------------------------------------------------------
+def _reduce_aggregation(ctx: QueryContext, results: List[AggSegmentResult], stats: ExecutionStats) -> ResultTable:
+    aggs = [get_agg_function(a.function) for a in ctx.aggregations]
+    merged: Optional[List[Dict[str, np.ndarray]]] = None
+    for r in results:
+        if merged is None:
+            merged = [dict(p) for p in r.partials]
+        else:
+            merged = [fn.merge(m, p) for fn, m, p in zip(aggs, merged, r.partials)]
+    row = []
+    if merged is None:
+        # all segments pruned: COUNT=0, others NULL
+        for fn in aggs:
+            row.append(0 if fn.name == "count" else None)
+    else:
+        for fn, p in zip(aggs, merged):
+            row.append(_scalar(fn.final(p)))
+    return ResultTable(columns=ctx.column_names_out(), rows=[tuple(row)], stats=stats)
+
+
+def _scalar(v):
+    v = np.asarray(v)
+    x = v.item() if v.ndim == 0 else v
+    if isinstance(x, float) and (math.isnan(x) or math.isinf(x)):
+        return None
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Group-by
+# ---------------------------------------------------------------------------
+def _reduce_groupby(ctx: QueryContext, results: List[GroupBySegmentResult], stats: ExecutionStats) -> ResultTable:
+    aggs = [get_agg_function(a.function) for a in ctx.aggregations]
+    results = [r for r in results if r is not None]
+    if not results:
+        return ResultTable(columns=ctx.column_names_out(), rows=[], stats=stats)
+
+    # -- aligned dense fast path ---------------------------------------
+    key_spaces = {r.dense.key_space for r in results if r.dense is not None}
+    if len(results) > 1 and len(key_spaces) == 1 and all(r.dense is not None for r in results):
+        d0 = results[0].dense
+        presence = np.zeros_like(d0.presence)
+        merged_partials = [
+            {f: np.full_like(arr, _ident_like(f, arr)) for f, arr in p.items()} for p in d0.partials
+        ]
+        for r in results:
+            presence = presence + r.dense.presence
+            for mp, p in zip(merged_partials, r.dense.partials):
+                for f in mp:
+                    mp[f] = combine_field(f, mp[f], np.asarray(p[f]))
+        present = np.nonzero(presence > 0)[0]
+        keys = _decode_dense_keys(d0.group_dims, present)
+        partials = [{f: arr[present] for f, arr in p.items()} for p in merged_partials]
+    elif len(results) == 1:
+        keys, partials = results[0].keys, results[0].partials
+    else:
+        keys, partials = _hash_merge(results, aggs)
+
+    stats.num_groups = len(keys[0]) if keys else 0
+    finals = [np.atleast_1d(np.asarray(fn.final(p))) for fn, p in zip(aggs, partials)]
+
+    # fingerprint -> column array, for select/having/order resolution
+    env: Dict[str, np.ndarray] = {}
+    for g, k in zip(ctx.group_by, keys):
+        env[g.fingerprint()] = k
+    for spec, f in zip(ctx.aggregations, finals):
+        env[spec.fingerprint()] = f
+        # HAVING/ORDER BY reference aggregations as plain calls: sum(v)
+        if spec.filter is None and not spec.literal_args:
+            call = Expr.call(spec.function, *([spec.expr] if spec.expr else []))
+            env.setdefault(call.fingerprint(), f)
+
+    # HAVING
+    n = len(keys[0]) if keys else 0
+    if ctx.having is not None and n:
+        mask = _eval_host_filter(ctx.having, env, n)
+        keys = [k[mask] for k in keys]
+        finals = [f[mask] for f in finals]
+        env = {k: v[mask] for k, v in env.items()}
+        n = int(mask.sum())
+
+    # output columns in select order
+    out_cols: List[np.ndarray] = []
+    for s in ctx.select_list:
+        fp = s.fingerprint()
+        if fp not in env:
+            raise ValueError(f"select item {s} is neither a group key nor an aggregation")
+        out_cols.append(env[fp])
+
+    rows = _rows_from_columns(out_cols, n)
+    rows = _order_and_trim(ctx, rows, [s.fingerprint() for s in ctx.select_list], env, n)
+    return ResultTable(columns=ctx.column_names_out(), rows=rows, stats=stats)
+
+
+def _ident_like(field: str, arr: np.ndarray):
+    from pinot_tpu.query.functions import field_identity
+
+    if field == "count":
+        return 0
+    return field_identity(field)
+
+
+def _decode_dense_keys(group_dims, present: np.ndarray) -> List[np.ndarray]:
+    strides = []
+    acc = 1
+    for gd in reversed(group_dims):
+        strides.append(acc)
+        acc *= gd.cardinality
+    strides = list(reversed(strides))
+    return [gd.decode(((present // st) % gd.cardinality).astype(np.int64)) for gd, st in zip(group_dims, strides)]
+
+
+def _hash_merge(results: List[GroupBySegmentResult], aggs) -> Tuple[List[np.ndarray], List[Dict[str, np.ndarray]]]:
+    """Generic keyed merge (IndexedTable upsert analog)."""
+    table: Dict[tuple, List[Dict[str, Any]]] = {}
+    for r in results:
+        n = len(r.keys[0]) if r.keys else 0
+        for i in range(n):
+            key = tuple(k[i] for k in r.keys)
+            partial = [{f: arr[i] for f, arr in p.items()} for p in r.partials]
+            cur = table.get(key)
+            if cur is None:
+                table[key] = partial
+            else:
+                table[key] = [fn.merge(a, b) for fn, a, b in zip(aggs, cur, partial)]
+    keys_out: List[np.ndarray] = []
+    ndims = len(results[0].keys)
+    all_keys = list(table.keys())
+    for d in range(ndims):
+        keys_out.append(np.asarray([k[d] for k in all_keys], dtype=object))
+    partials_out: List[Dict[str, np.ndarray]] = []
+    for ai, fn in enumerate(aggs):
+        fields = results[0].partials[ai].keys()
+        partials_out.append({f: np.asarray([table[k][ai][f] for k in all_keys]) for f in fields})
+    return keys_out, partials_out
+
+
+# ---------------------------------------------------------------------------
+# Selection
+# ---------------------------------------------------------------------------
+def _reduce_selection(ctx: QueryContext, results: List[SelectionSegmentResult], stats: ExecutionStats) -> ResultTable:
+    results = [r for r in results if r is not None]
+    out_names = ctx.column_names_out()
+    if not results:
+        return ResultTable(columns=out_names, rows=[], stats=stats)
+    cols = results[0].columns
+    arrays = {
+        c: np.concatenate([np.asarray(r.arrays[c], dtype=object) for r in results])
+        if len(results) > 1
+        else np.asarray(results[0].arrays[c], dtype=object)
+        for c in cols
+    }
+    n = len(next(iter(arrays.values()))) if arrays else 0
+    select_cols = [c for c in cols if not c.startswith("__ord")]
+    rows = _rows_from_columns([arrays[c] for c in select_cols], n)
+    if ctx.order_by:
+        ord_vals = [arrays[f"__ord{i}"] for i in range(len(ctx.order_by))]
+        order = _sorted_order(ctx.order_by, ord_vals, n)
+        rows = [rows[i] for i in order]
+    rows = rows[ctx.offset: ctx.offset + ctx.limit]
+    return ResultTable(columns=out_names, rows=rows, stats=stats)
+
+
+# ---------------------------------------------------------------------------
+# Shared helpers
+# ---------------------------------------------------------------------------
+def _rows_from_columns(cols: Sequence[np.ndarray], n: int) -> List[tuple]:
+    rows = []
+    for i in range(n):
+        rows.append(tuple(_scalar(c[i]) if not isinstance(c[i], (str, bytes, type(None))) else c[i] for c in cols))
+    return rows
+
+
+def _sorted_order(order_by: List[OrderByExpr], ord_vals: List[np.ndarray], n: int) -> List[int]:
+    """Stable index sort honoring asc/desc + nulls placement, robust to
+    mixed/None/object values (python comparison semantics)."""
+
+    def cmp(i: int, j: int) -> int:
+        for ob, vals in zip(order_by, ord_vals):
+            a, b = vals[i], vals[j]
+            if a is None or b is None:
+                if a is None and b is None:
+                    continue
+                null_first = not ob.nulls_last
+                if a is None:
+                    return -1 if null_first else 1
+                return 1 if null_first else -1
+            if a == b:
+                continue
+            less = a < b
+            if ob.ascending:
+                return -1 if less else 1
+            return 1 if less else -1
+        return i - j  # stable tiebreak
+
+    return sorted(range(n), key=functools.cmp_to_key(cmp))
+
+
+def _order_and_trim(
+    ctx: QueryContext,
+    rows: List[tuple],
+    select_fps: List[str],
+    env: Dict[str, np.ndarray],
+    n: int,
+) -> List[tuple]:
+    if ctx.order_by:
+        ord_vals = []
+        for ob in ctx.order_by:
+            fp = ob.expr.fingerprint()
+            if fp not in env:
+                raise ValueError(f"ORDER BY {ob.expr} must be a select/group/aggregation expression")
+            vals = env[fp]
+            ord_vals.append(np.asarray([_scalar(v) if not isinstance(v, (str, bytes, type(None))) else v for v in vals], dtype=object))
+        order = _sorted_order(ctx.order_by, ord_vals, n)
+        rows = [rows[i] for i in order]
+    return rows[ctx.offset: ctx.offset + ctx.limit]
+
+
+def _eval_host_filter(node: FilterNode, env: Dict[str, np.ndarray], n: int) -> np.ndarray:
+    """HAVING evaluation over final (already-aggregated) columns."""
+    if node.op is FilterOp.AND:
+        m = np.ones(n, dtype=bool)
+        for c in node.children:
+            m &= _eval_host_filter(c, env, n)
+        return m
+    if node.op is FilterOp.OR:
+        m = np.zeros(n, dtype=bool)
+        for c in node.children:
+            m |= _eval_host_filter(c, env, n)
+        return m
+    if node.op is FilterOp.NOT:
+        return ~_eval_host_filter(node.children[0], env, n)
+    p = node.predicate
+    fp = p.lhs.fingerprint()
+    if fp not in env:
+        raise ValueError(f"HAVING references {p.lhs}, which is not in the select/group list")
+    vals = env[fp]
+    if p.ptype is PredicateType.EQ:
+        return np.asarray([v == p.values[0] for v in vals], dtype=bool)
+    if p.ptype is PredicateType.NEQ:
+        return np.asarray([v is not None and v != p.values[0] for v in vals], dtype=bool)
+    if p.ptype in (PredicateType.IN, PredicateType.NOT_IN):
+        s = set(p.values)
+        m = np.asarray([v in s for v in vals], dtype=bool)
+        return ~m if p.ptype is PredicateType.NOT_IN else m
+    if p.ptype is PredicateType.RANGE:
+        m = np.ones(n, dtype=bool)
+        for i, v in enumerate(vals):
+            if v is None:
+                m[i] = False
+                continue
+            if p.lower is not None and not (v >= p.lower if p.lower_inclusive else v > p.lower):
+                m[i] = False
+            if p.upper is not None and not (v <= p.upper if p.upper_inclusive else v < p.upper):
+                m[i] = False
+        return m
+    raise ValueError(f"HAVING predicate {p.ptype} unsupported")

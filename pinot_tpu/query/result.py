@@ -1,0 +1,109 @@
+"""Result containers flowing segment -> combine -> broker reduce.
+
+Reference parity: per-segment result blocks + the DataTable payload
+(IntermediateResultsBlock / DataTableImplV4, SURVEY.md 2.2).  Re-design:
+results stay columnar numpy end-to-end; "serialization" only exists at the
+client boundary (JSON), since combine happens via collectives/arrays, not
+sockets.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class ExecutionStats:
+    """Per-query execution statistics (ExecutionStatistics /
+    BrokerResponse stats analog)."""
+
+    num_segments_queried: int = 0
+    num_segments_pruned: int = 0
+    num_segments_processed: int = 0
+    num_docs_scanned: int = 0
+    total_docs: int = 0
+    num_groups: int = 0
+    time_ms: float = 0.0
+
+    def merge(self, other: "ExecutionStats") -> None:
+        self.num_segments_queried += other.num_segments_queried
+        self.num_segments_pruned += other.num_segments_pruned
+        self.num_segments_processed += other.num_segments_processed
+        self.num_docs_scanned += other.num_docs_scanned
+        self.total_docs += other.total_docs
+        self.num_groups = max(self.num_groups, other.num_groups)
+
+
+@dataclass
+class AggSegmentResult:
+    """Scalar aggregation partials: one Partial (dict of np scalars) per agg."""
+
+    partials: List[Dict[str, np.ndarray]]
+
+
+@dataclass
+class GroupBySegmentResult:
+    """Columnar group-by partials.
+
+    keys: one np array per group dimension (decoded values; dtype=object when
+    the dimension can hold None).  partials[i][field] is aligned with keys.
+    dense_meta carries (num_groups, dim cardinalities, decode tables id) when
+    the result came off the dense kernel with its FULL key space intact —
+    enabling the aligned array merge fast path in reduce.py."""
+
+    keys: List[np.ndarray]
+    partials: List[Dict[str, np.ndarray]]
+    dense: Optional["DenseGroupData"] = None
+
+
+@dataclass
+class DenseGroupData:
+    """Full dense group table straight from the device kernel (before
+    presence filtering) — kept when segments share a key space so the combine
+    is pure array addition (the psum-shaped path)."""
+
+    presence: np.ndarray  # int32[num_groups]
+    partials: List[Dict[str, np.ndarray]]  # field arrays [num_groups]
+    key_space: Tuple  # hashable id of the decode tables (see reduce.py)
+    group_dims: List[Any] = field(default_factory=list)  # planner.GroupDim (decode)
+
+
+@dataclass
+class SelectionSegmentResult:
+    columns: List[str]  # gathered columns (select + order-by needs)
+    arrays: Dict[str, np.ndarray]
+
+
+SegmentResult = Any  # union of the three above
+
+
+@dataclass
+class ResultTable:
+    """Final client-facing result (BrokerResponse resultTable analog)."""
+
+    columns: List[str]
+    rows: List[tuple]
+    stats: ExecutionStats = field(default_factory=ExecutionStats)
+
+    def to_dict(self) -> Dict[str, Any]:
+        def _py(v):
+            if isinstance(v, np.generic):
+                return v.item()
+            if isinstance(v, bytes):
+                return v.decode("latin-1")
+            return v
+
+        return {
+            "resultTable": {
+                "dataSchema": {"columnNames": self.columns},
+                "rows": [[_py(v) for v in r] for r in self.rows],
+            },
+            "numSegmentsQueried": self.stats.num_segments_queried,
+            "numSegmentsPruned": self.stats.num_segments_pruned,
+            "numSegmentsProcessed": self.stats.num_segments_processed,
+            "numDocsScanned": self.stats.num_docs_scanned,
+            "totalDocs": self.stats.total_docs,
+            "timeUsedMs": self.stats.time_ms,
+        }

@@ -50,6 +50,25 @@ class Dictionary:
     def cardinality(self) -> int:
         return len(self.values)
 
+    _fp_cache: Optional[str] = None
+
+    def fingerprint(self) -> str:
+        """Content hash of the value set — used to detect segments that share
+        a key space (aligned dense group-by merges, reduce.py)."""
+        if self._fp_cache is None:
+            import hashlib
+
+            h = hashlib.blake2b(digest_size=12)
+            if self.data_type.is_string_like:
+                for v in self.values:
+                    b = v if isinstance(v, bytes) else str(v).encode("utf-8")
+                    h.update(len(b).to_bytes(4, "little"))  # length-prefix: no delimiter collisions
+                    h.update(b)
+            else:
+                h.update(np.ascontiguousarray(self.values).tobytes())
+            object.__setattr__(self, "_fp_cache", h.hexdigest())
+        return self._fp_cache
+
     @property
     def code_dtype(self) -> np.dtype:
         return min_code_dtype(self.cardinality)
@@ -103,9 +122,13 @@ class Dictionary:
         return self.values[np.asarray(dict_ids)]
 
     def _coerce(self, value):
-        if self.data_type.is_string_like:
-            return value
-        return self.data_type.np_dtype.type(value)
+        """Keep literals semantically intact: numpy compares/searchsorts
+        cross-dtype correctly (2.5 lands between 2 and 3 in an int dict and
+        equals nothing), whereas casting to the column dtype would truncate
+        and match the wrong rows."""
+        if isinstance(value, np.generic):
+            return value.item()
+        return value
 
     # -- device ----------------------------------------------------------
     def device_values(self) -> Optional[np.ndarray]:

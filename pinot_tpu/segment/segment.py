@@ -80,7 +80,7 @@ class ImmutableSegment:
         self.indexes: Dict[str, Dict[str, Any]] = indexes or {}
         self.creation_time_ms = creation_time_ms
         self.time_range = time_range  # (min, max) of the table's time column
-        self._device_cache: Optional[Dict[str, Any]] = None
+        self._device_cache: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------
     def column(self, name: str) -> ColumnData:
@@ -102,11 +102,11 @@ class ImmutableSegment:
         residency manager in query/executor.py)."""
         import jax
 
-        if self._device_cache is not None and columns is None:
-            return self._device_cache
+        cache = self._device_cache.setdefault(device, {})
         cols = columns or list(self.columns)
-        out: Dict[str, Any] = {}
         for cname in cols:
+            if cname in cache:
+                continue
             c = self.columns[cname]
             entry: Dict[str, Any] = {}
             if c.codes is not None:
@@ -118,13 +118,11 @@ class ImmutableSegment:
                 entry["values"] = jax.device_put(np.asarray(c.values), device)
             if c.nulls is not None:
                 entry["nulls"] = jax.device_put(np.asarray(c.nulls), device)
-            out[cname] = entry
-        if columns is None:
-            self._device_cache = out
-        return out
+            cache[cname] = entry
+        return {cname: cache[cname] for cname in cols}
 
     def release_device(self) -> None:
-        self._device_cache = None
+        self._device_cache = {}
 
     # -- persistence ----------------------------------------------------
     def save(self, path: str) -> None:

@@ -1,0 +1,89 @@
+"""Golden-reference harness: run the same query on pinot_tpu and sqlite3 and
+compare — the H2-checked query-correctness tier of the reference
+(ClusterIntegrationTestUtils.setUpH2TableWithAvro, SURVEY.md section 4)."""
+from __future__ import annotations
+
+import math
+import sqlite3
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def sqlite_from_data(name: str, data: Dict[str, np.ndarray], nulls: Optional[Dict[str, np.ndarray]] = None):
+    conn = sqlite3.connect(":memory:")
+    cols = list(data)
+    n = len(data[cols[0]])
+    decls = []
+    for c in cols:
+        arr = np.asarray(data[c])
+        if arr.dtype == object and any(isinstance(v, str) for v in arr if v is not None):
+            decls.append(f'"{c}" TEXT')
+        elif np.issubdtype(arr.dtype, np.floating) or (
+            arr.dtype == object and any(isinstance(v, float) for v in arr if v is not None)
+        ):
+            decls.append(f'"{c}" REAL')
+        else:
+            decls.append(f'"{c}" INTEGER')
+    conn.execute(f"CREATE TABLE {name} ({', '.join(decls)})")
+    rows = []
+    for i in range(n):
+        row = []
+        for c in cols:
+            v = data[c][i]
+            if nulls and c in nulls and nulls[c] is not None and nulls[c][i]:
+                v = None
+            elif isinstance(v, float) and math.isnan(v):
+                v = None
+            elif isinstance(v, np.generic):
+                v = v.item()
+            row.append(v)
+        rows.append(tuple(row))
+    conn.executemany(f"INSERT INTO {name} VALUES ({','.join('?' * len(cols))})", rows)
+    conn.commit()
+    return conn
+
+
+def normalize_rows(rows: Sequence[Sequence], float_tol: float = 1e-6) -> List[tuple]:
+    out = []
+    for r in rows:
+        nr = []
+        for v in r:
+            if isinstance(v, np.generic):
+                v = v.item()
+            if isinstance(v, float):
+                if math.isnan(v):
+                    v = None
+                else:
+                    v = round(v, 6)
+                    if v == int(v) and abs(v) < 1e15:
+                        v = float(v)  # keep float type but canonical
+            nr.append(v)
+        out.append(tuple(nr))
+    return out
+
+
+def _canon(v):
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return ("num", int(v))
+    if isinstance(v, int):
+        return ("num", v)
+    if v is None:
+        return ("null",)
+    return (type(v).__name__, v)
+
+
+def assert_same_rows(got: Sequence, expected: Sequence, ordered: bool = False):
+    g = [tuple(_canon(v) for v in r) for r in normalize_rows(got)]
+    e = [tuple(_canon(v) for v in r) for r in normalize_rows(expected)]
+    if not ordered:
+        g, e = sorted(g), sorted(e)
+    assert g == e, f"rows differ:\n got      {g[:10]}\n expected {e[:10]}\n (lens {len(g)} vs {len(e)})"
+
+
+def check_against_sqlite(engine, conn, sql_pinot: str, sql_lite: Optional[str] = None, ordered: bool = False):
+    """Run on both engines and compare (sql_lite defaults to sql_pinot)."""
+    res = engine.query(sql_pinot)
+    expected = conn.execute(sql_lite or sql_pinot).fetchall()
+    assert_same_rows(res.rows, expected, ordered=ordered)
+    return res

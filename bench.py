@@ -1,0 +1,83 @@
+"""Headline bench: SSB-style group-by scan rate on real TPU hardware.
+
+Config 2 of BASELINE.json: lineorder `WHERE lo_quantity < 25 GROUP BY
+lo_orderdate SUM(lo_revenue)` — filter + dense group-by aggregation, the
+reference's hot path (BenchmarkQueriesSSQE shape). Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+vs_baseline: the reference publishes no absolute numbers (BASELINE.md).  We
+normalize against 500M rows/sec — an optimistic estimate of a whole Java
+server's scan-aggregate throughput on this query shape (Pinot's per-core JMH
+scan rates are tens of millions of rows/sec; a 16-core server lands near
+this).  vs_baseline = rows_per_sec / 5e8, i.e. 1.0 means parity with a full
+Java server on one TPU chip; the north-star 10x target is vs_baseline >= 10.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+JAVA_SERVER_ROWS_PER_SEC = 5e8  # assumed reference throughput (see docstring)
+N_ROWS = 1 << 27  # 134M rows
+
+
+def main() -> None:
+    import jax
+
+    from pinot_tpu.parallel.engine import DistributedEngine
+    from pinot_tpu.parallel.stacked import StackedTable
+    from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+    from pinot_tpu.sql.parser import parse_query
+
+    rng = np.random.default_rng(42)
+    n = N_ROWS
+    schema = Schema(
+        "lineorder",
+        [
+            FieldSpec("lo_orderdate", DataType.INT),
+            FieldSpec("lo_quantity", DataType.INT),
+            FieldSpec("lo_revenue", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+    data = {
+        "lo_orderdate": (19920101 + rng.integers(0, 2406, n)).astype(np.int32),
+        "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
+        "lo_revenue": rng.integers(100, 1_000_000, n).astype(np.int64),
+    }
+
+    ndev = len(jax.devices())
+    stacked = StackedTable.build(schema, data, num_shards=ndev)
+    engine = DistributedEngine()
+    engine.register_table("lineorder", stacked)
+
+    ctx = parse_query(
+        "SELECT lo_orderdate, SUM(lo_revenue) FROM lineorder "
+        "WHERE lo_quantity < 25 GROUP BY lo_orderdate LIMIT 2500"
+    )
+
+    engine.execute(ctx)  # warm-up: compile + HBM pin
+    times = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        r = engine.execute(ctx)
+        times.append(time.perf_counter() - t0)
+    assert r.rows, "bench query returned nothing"
+    t = float(np.median(times))
+    rows_per_sec = n / t
+
+    print(
+        json.dumps(
+            {
+                "metric": "ssb_groupby_rows_scanned_per_sec",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/sec",
+                "vs_baseline": round(rows_per_sec / JAVA_SERVER_ROWS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

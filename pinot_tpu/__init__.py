@@ -22,9 +22,17 @@ Layer map (mirrors SURVEY.md section 1, re-architected):
 # OLAP semantics require 64-bit LONG/DOUBLE (Pinot aggregates into long/double;
 # golden tests compare against 64-bit sqlite). Hot-path code arrays stay int32/
 # uint8/16; only reductions widen.  Must run before any jax array creation.
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Honor JAX_PLATFORMS even when an ambient sitecustomize pre-registered a
+# hardware platform before this env var could take effect (the config path
+# works where the env latch does not; no-op on normal installations).
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,19 @@
+"""Device kernels / numeric primitives (the XLA/Pallas op layer).
+
+The slot where the reference's JNI-native dependencies live (SURVEY.md 2.4):
+here they are TPU kernels — segmented reductions, bitmap algebra, sketch
+updates — shared by the SSE planner, the distributed engine and the MSE.
+"""
+from pinot_tpu.ops.segmented import (  # noqa: F401
+    accum_policy,
+    group_count,
+    group_max,
+    group_min,
+    group_sum,
+    group_sum_sq,
+    masked_count,
+    masked_max,
+    masked_min,
+    masked_sum,
+    masked_sum_sq,
+)

@@ -1,0 +1,290 @@
+"""Segmented (grouped) and masked reductions — the aggregation hot path.
+
+Reference parity: the per-row accumulate loops of DefaultGroupByExecutor +
+the typed result holders (pinot-core/.../query/aggregation/groupby/
+DefaultGroupByExecutor.java:192, result holders in the same package).  Pinot
+accumulates into on-heap double[]/long[] arrays indexed by group id; the TPU
+form maps the same computation onto the MXU.
+
+TPU-native design (measured on v5e; numbers for 16M rows x 2406 groups):
+  * TPUs have no 64-bit ALU: under jax_enable_x64, f64/i64 arithmetic is
+    software-emulated (~50x slower on big arrays) and jax.ops.segment_sum
+    promotes its scatter indices to int64 (1.75s vs 110us for a raw
+    int32-index lax.scatter_add).  Nothing here ever touches 64-bit types on
+    the row axis.
+  * XLA lowers scatter to a serialized loop on TPU: even an f32 scatter-add
+    group-by runs at ~0.15 Grows/s.  The MXU answer is the TWO-LEVEL ONE-HOT
+    MATMUL: split code = hi*64 + lo, build two narrow one-hot matrices
+    (n x H and n x 64 — n*(H+64) VPU compares instead of n*G), then
+    (A * v)^T @ B accumulates the whole [H, 64] group table as one matmul.
+    ~11 Grows/s in f32.
+  * Exact integer sums at MXU speed: decompose values into 8-bit limbs —
+    every limb (< 256) is exact in bfloat16, every per-chunk dot accumulates
+    < 2^24 in the MXU's f32 accumulator, so each limb matmul is EXACT.  The
+    per-chunk [limb, H, 64] tables are recombined in (emulated) f64, which is
+    cheap at table size.  Negative int32 values ride a fifth limb: the
+    two's-complement reinterpretation plus a -2^32 * count(v<0) correction.
+    3.7-2.7 Grows/s, error == 0.  (Pinot's double accumulators round above
+    2^53; this path doesn't round at all for int32 inputs.)
+  * Float sums use the single-f32 matmul (~1e-5 worst-case relative error;
+    float-float "double-single" limbs are a planned upgrade).
+  * Group tables wider than _MATMUL_MAX_GROUPS fall back to the f32 scatter
+    (correct, slower); min/max always use scatter (no matmul semiring).
+  * On CPU (tests, golden comparisons) the "wide" policy scatters directly
+    in f64/i64 — bit-exact vs sqlite — still with int32 indices.
+
+All functions take a boolean mask (filter + null handling folded in by the
+caller) and return f64 (i64 for counts) outputs; outputs are group-table
+sized, so the final widening costs nothing.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# Rows per chunk for the matmul path: limb sums stay < 2^24 (255 * 65536),
+# i.e. exact in the MXU's f32 accumulator.
+_CHUNK = 1 << 16
+# Lane width of the two-level decomposition (code = hi * _W + lo).
+_W = 64
+# Above this group count the one-hot matrices stop paying for themselves.
+_MATMUL_MAX_GROUPS = 8192
+
+_POS_INF32 = np.float32(np.inf)
+_NEG_INF32 = np.float32(-np.inf)
+
+
+@functools.lru_cache(maxsize=None)
+def accum_policy() -> str:
+    """"wide" (native 64-bit, CPU) or "chunked32" (32-bit kernels + small
+    f64 combines, TPU and any backend without 64-bit ALUs)."""
+    return "wide" if jax.default_backend() == "cpu" else "chunked32"
+
+
+def _i32(codes):
+    return codes.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Scatter primitives (explicit int32 indices)
+# ---------------------------------------------------------------------------
+def _scatter_add(target, idx_i32, updates):
+    dnums = lax.ScatterDimensionNumbers(
+        update_window_dims=(), inserted_window_dims=(0,), scatter_dims_to_operand_dims=(0,)
+    )
+    return lax.scatter_add(
+        target, idx_i32[:, None], updates, dnums,
+        indices_are_sorted=False, unique_indices=False,
+        mode=lax.GatherScatterMode.FILL_OR_DROP,
+    )
+
+
+def _scatter_extreme(target, idx_i32, updates, *, is_min: bool):
+    dnums = lax.ScatterDimensionNumbers(
+        update_window_dims=(), inserted_window_dims=(0,), scatter_dims_to_operand_dims=(0,)
+    )
+    op = lax.scatter_min if is_min else lax.scatter_max
+    return op(
+        target, idx_i32[:, None], updates, dnums,
+        indices_are_sorted=False, unique_indices=False,
+        mode=lax.GatherScatterMode.FILL_OR_DROP,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Two-level one-hot matmul core (chunked32 group path)
+# ---------------------------------------------------------------------------
+def _pad_to_chunks(*arrays):
+    """Pad row arrays to a multiple of _CHUNK (padding rows carry mask=False
+    via the first array being the already-masked values/False mask)."""
+    n = arrays[0].shape[0]
+    rem = n % _CHUNK
+    if rem == 0:
+        return arrays
+    pad = _CHUNK - rem
+    return tuple(
+        jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1)) for a in arrays
+    )
+
+
+def _matmul_group_table(weighted_limbs, scales, codes, num_groups: int):
+    """Core: sum of scales[l] * sum_rows(limb_l[row] * onehot(code)) tables.
+
+    weighted_limbs: [n, L] bf16 (each limb value exact in bf16, masked rows 0)
+    scales: f64[L] recombination factors
+    Returns f64[num_groups]."""
+    H = -(-num_groups // _W)
+    n = weighted_limbs.shape[0]
+    weighted_limbs, codes = _pad_to_chunks(weighted_limbs, _i32(codes))
+    L = weighted_limbs.shape[1] if weighted_limbs.ndim == 2 else 1
+    v_r = weighted_limbs.reshape(-1, _CHUNK, L)
+    k_r = codes.reshape(-1, _CHUNK)
+    scales = jnp.asarray(scales, jnp.float64)
+
+    def body(acc, xs):
+        li, ki = xs
+        hi = ki // np.int32(_W)
+        lo = ki % np.int32(_W)
+        A = jax.nn.one_hot(hi, H, dtype=jnp.bfloat16)  # [C, H]
+        B = jax.nn.one_hot(lo, _W, dtype=jnp.bfloat16)  # [C, W]
+        S = jnp.einsum("cl,ch,cw->lhw", li, A, B, preferred_element_type=jnp.float32)
+        tot = (S.astype(jnp.float64) * scales[:, None, None]).sum(0)
+        return acc + tot, None
+
+    acc, _ = lax.scan(body, jnp.zeros((H, _W), jnp.float64), (v_r, k_r))
+    return acc.reshape(-1)[:num_groups]
+
+
+def _matmul_group_sum_f32(values_f32, codes, num_groups: int):
+    """Float path: single f32 matmul per chunk (~1e-5 relative error)."""
+    H = -(-num_groups // _W)
+    values_f32, codes = _pad_to_chunks(values_f32, _i32(codes))
+    v_r = values_f32.reshape(-1, _CHUNK)
+    k_r = codes.reshape(-1, _CHUNK)
+
+    def body(acc, xs):
+        vi, ki = xs
+        hi = ki // np.int32(_W)
+        lo = ki % np.int32(_W)
+        A = jax.nn.one_hot(hi, H, dtype=jnp.float32)
+        B = jax.nn.one_hot(lo, _W, dtype=jnp.float32)
+        S = jnp.einsum("ch,cw->hw", A * vi[:, None], B, preferred_element_type=jnp.float32)
+        return acc + S.astype(jnp.float64), None
+
+    acc, _ = lax.scan(body, jnp.zeros((H, _W), jnp.float64), (v_r, k_r))
+    return acc.reshape(-1)[:num_groups]
+
+
+# ---------------------------------------------------------------------------
+# Grouped reductions
+# ---------------------------------------------------------------------------
+def group_sum(values, mask, codes, num_groups: int):
+    """f64[num_groups] sum of values where mask, by group code."""
+    codes = _i32(codes)
+    if accum_policy() == "wide":
+        v = jnp.where(mask, values.astype(jnp.float64), 0.0)
+        return _scatter_add(jnp.zeros((num_groups,), jnp.float64), codes, v)
+    if num_groups > _MATMUL_MAX_GROUPS:
+        return _scatter_group_sum_f32(values, mask, codes, num_groups)
+    if jnp.issubdtype(values.dtype, jnp.integer) and values.dtype.itemsize <= 4:
+        # exact limb path (int32 and narrower)
+        vm = jnp.where(mask, values, np.int32(0)).astype(jnp.int32)
+        u = vm.astype(jnp.uint32)
+        limbs = [((u >> np.uint32(8 * i)) & np.uint32(0xFF)).astype(jnp.bfloat16) for i in range(4)]
+        limbs.append((vm < 0).astype(jnp.bfloat16))  # two's-complement correction
+        stacked = jnp.stack(limbs, axis=1)
+        scales = [float(1 << (8 * i)) for i in range(4)] + [-float(1 << 32)]
+        return _matmul_group_table(stacked, scales, codes, num_groups)
+    v = jnp.where(mask, values.astype(jnp.float32), np.float32(0.0))
+    return _matmul_group_sum_f32(v, codes, num_groups)
+
+
+def _scatter_group_sum_f32(values, mask, codes, num_groups: int):
+    """Fallback for wide group tables: chunked f32 scatter + f64 combine."""
+    n = values.shape[0]
+    k = -(-n // _CHUNK)
+    v = jnp.where(mask, values.astype(jnp.float32), np.float32(0.0))
+    chunk_ids = lax.iota(jnp.int32, n) // np.int32(_CHUNK)
+    idx = chunk_ids * np.int32(num_groups) + codes
+    table = _scatter_add(jnp.zeros((k * num_groups,), jnp.float32), idx, v)
+    return table.reshape(k, num_groups).astype(jnp.float64).sum(axis=0)
+
+
+def group_sum_sq(values, mask, codes, num_groups: int):
+    if accum_policy() == "wide":
+        v = values.astype(jnp.float64)
+        return group_sum(v * v, mask, codes, num_groups)
+    v = values.astype(jnp.float32)
+    return group_sum(v * v, mask, codes, num_groups)
+
+
+def group_count(mask, codes, num_groups: int):
+    """i64[num_groups] count of mask-true rows by group code."""
+    codes = _i32(codes)
+    if accum_policy() == "wide":
+        return _scatter_add(jnp.zeros((num_groups,), jnp.int64), codes, mask.astype(jnp.int64))
+    if num_groups > _MATMUL_MAX_GROUPS:
+        n = mask.shape[0]
+        k = -(-n // _CHUNK)
+        chunk_ids = lax.iota(jnp.int32, n) // np.int32(_CHUNK)
+        idx = chunk_ids * np.int32(num_groups) + codes
+        table = _scatter_add(jnp.zeros((k * num_groups,), jnp.int32), idx, mask.astype(jnp.int32))
+        return table.reshape(k, num_groups).astype(jnp.int64).sum(axis=0)
+    # single-limb matmul: per-chunk counts <= _CHUNK, exact in f32
+    stacked = mask.astype(jnp.bfloat16)[:, None]
+    return _matmul_group_table(stacked, [1.0], codes, num_groups).astype(jnp.int64)
+
+
+def group_min(values, mask, codes, num_groups: int):
+    """f64[num_groups]; +inf where a group matched no rows.
+
+    chunked32 note: f32 scatter (values round to f32; exact below 2^24).
+    Scatter is the slow path on TPU — acceptable because min/max group-bys
+    are rare vs sum/count; a Pallas tiled kernel is the planned upgrade."""
+    codes = _i32(codes)
+    if accum_policy() == "wide":
+        v = jnp.where(mask, values.astype(jnp.float64), jnp.float64(np.inf))
+        return _scatter_extreme(jnp.full((num_groups,), np.float64(np.inf)), codes, v, is_min=True)
+    v = jnp.where(mask, values.astype(jnp.float32), _POS_INF32)
+    out = _scatter_extreme(jnp.full((num_groups,), _POS_INF32), codes, v, is_min=True)
+    return out.astype(jnp.float64)
+
+
+def group_max(values, mask, codes, num_groups: int):
+    codes = _i32(codes)
+    if accum_policy() == "wide":
+        v = jnp.where(mask, values.astype(jnp.float64), jnp.float64(-np.inf))
+        return _scatter_extreme(jnp.full((num_groups,), np.float64(-np.inf)), codes, v, is_min=False)
+    v = jnp.where(mask, values.astype(jnp.float32), _NEG_INF32)
+    out = _scatter_extreme(jnp.full((num_groups,), _NEG_INF32), codes, v, is_min=False)
+    return out.astype(jnp.float64)
+
+
+# ---------------------------------------------------------------------------
+# Masked scalar reductions (aggregation without group-by)
+# ---------------------------------------------------------------------------
+def masked_count(mask):
+    """i64 scalar count (reduce in i32, widen the scalar)."""
+    if accum_policy() == "wide":
+        return jnp.sum(mask, dtype=jnp.int64)
+    return jnp.sum(mask, dtype=jnp.int32).astype(jnp.int64)
+
+
+def masked_sum(values, mask):
+    """f64 scalar masked sum.
+
+    chunked32: XLA's tree reduction in f32 keeps relative error ~2^-24 *
+    log2(n); exact-integer upgrades ride the group path when needed."""
+    if accum_policy() == "wide":
+        return jnp.sum(jnp.where(mask, values.astype(jnp.float64), 0.0))
+    n = values.shape[0]
+    v = jnp.where(mask, values.astype(jnp.float32), np.float32(0.0))
+    # two-stage: f32 chunk sums (vectorized reduce), f64 combine of the
+    # small vector — bounds error without the scatter.
+    (v,) = _pad_to_chunks(v)
+    return v.reshape(-1, _CHUNK).sum(axis=1).astype(jnp.float64).sum()
+
+
+def masked_sum_sq(values, mask):
+    if accum_policy() == "wide":
+        v = values.astype(jnp.float64)
+        return masked_sum(v * v, mask)
+    v = values.astype(jnp.float32)
+    return masked_sum(v * v, mask)
+
+
+def masked_min(values, mask):
+    """f64 scalar; +inf when nothing matched."""
+    if accum_policy() == "wide":
+        return jnp.min(jnp.where(mask, values.astype(jnp.float64), jnp.float64(np.inf)))
+    return jnp.min(jnp.where(mask, values.astype(jnp.float32), _POS_INF32)).astype(jnp.float64)
+
+
+def masked_max(values, mask):
+    if accum_policy() == "wide":
+        return jnp.max(jnp.where(mask, values.astype(jnp.float64), jnp.float64(-np.inf)))
+    return jnp.max(jnp.where(mask, values.astype(jnp.float32), _NEG_INF32)).astype(jnp.float64)

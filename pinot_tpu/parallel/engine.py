@@ -1,0 +1,409 @@
+"""Distributed query engine: one shard_map kernel per query over the mesh.
+
+Reference parity: the whole distributed SSE path in one construct —
+QueryRouter.submitQuery scatter (pinot-core/.../transport/QueryRouter.java:77)
++ BaseCombineOperator worker pool (.../combine/BaseCombineOperator.java:202)
++ BrokerReduceService merge (.../query/reduce/BrokerReduceService.java:65).
+
+Re-design (SURVEY.md section 7 "Combine = collective"): there is no transport.
+Segments live stacked+sharded in HBM across the mesh (stacked.py); a query
+compiles to ONE shard_map kernel that filters/aggregates its local shard rows
+and merges partials IN-GRAPH with lax.psum/pmin/pmax over the ICI axis.  The
+host sees already-combined results; the remaining broker work (HAVING, ORDER
+BY, LIMIT, formatting) reuses query/reduce.py verbatim.
+
+DataTable/Netty have no analog here by design: the wire format between
+"servers" (shards) is an XLA collective over ICI/DCN (SURVEY.md 2.6).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from types import SimpleNamespace
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pinot_tpu import ops
+from pinot_tpu.query import executor as sse_executor
+from pinot_tpu.query import reduce as reduce_mod
+from pinot_tpu.query.filter import FilterCompiler
+from pinot_tpu.query.functions import FIELD_COMBINE, get_agg_function
+from pinot_tpu.query.ir import AggregationSpec, Expr, QueryContext
+from pinot_tpu.query.planner import GroupDim, _group_dim
+from pinot_tpu.query.result import (
+    AggSegmentResult,
+    DenseGroupData,
+    ExecutionStats,
+    GroupBySegmentResult,
+    ResultTable,
+    SelectionSegmentResult,
+)
+from pinot_tpu.query.transform import as_row_array, eval_expr
+
+
+def _psum_field(name: str, x, axis: str):
+    op = FIELD_COMBINE[name]
+    if op == "add":
+        return lax.psum(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    return lax.pmax(x, axis)
+
+
+class _ShardView:
+    """Compile-time segment facade over a StackedTable: FilterCompiler and
+    transform tracing only consult metadata (dictionaries, nulls, dtypes) and
+    num_docs for match-all shapes — here num_docs is the per-device flat row
+    count (local shards x docs_per_shard)."""
+
+    def __init__(self, stacked, local_rows: int):
+        self._stacked = stacked
+        self.num_docs = local_rows
+        self.schema = stacked.schema
+
+    def column(self, name: str):
+        return self._stacked.column(name)
+
+
+@dataclass
+class _DistPlan:
+    kind: str  # aggregation | groupby_dense | groupby_sparse | selection
+    fn: Callable  # jitted shard_map kernel(cols, valid, params)
+    params: Dict[str, Any]
+    needed_columns: List[str]
+    aggs: List[Any]
+    group_dims: List[GroupDim]
+    num_groups: int
+    select_columns: List[str]
+
+
+class DistributedEngine:
+    """Executes queries over a StackedTable sharded on a device mesh."""
+
+    def __init__(self, mesh=None, axis: str = "seg"):
+        if mesh is None:
+            from pinot_tpu.parallel.mesh import default_mesh
+
+            mesh = default_mesh(axis)
+        self.mesh = mesh
+        self.axis = axis
+        self.tables: Dict[str, Any] = {}  # name -> StackedTable
+        self._plan_cache: Dict[Tuple, _DistPlan] = {}
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.mesh.devices.shape))
+
+    def register_table(self, name: str, stacked) -> None:
+        if stacked.num_shards % self.num_devices:
+            raise ValueError(
+                f"num_shards={stacked.num_shards} not divisible by mesh size {self.num_devices}"
+            )
+        self.tables[name] = stacked
+
+    # ------------------------------------------------------------------
+    def query(self, sql: str) -> ResultTable:
+        from pinot_tpu.sql.parser import parse_query
+
+        return self.execute(parse_query(sql))
+
+    def execute(self, ctx: QueryContext) -> ResultTable:
+        import time
+
+        t0 = time.perf_counter()
+        stacked = self.tables[ctx.table]
+        stats = ExecutionStats(
+            num_segments_queried=stacked.num_shards,
+            num_segments_processed=stacked.num_shards,
+            num_docs_scanned=stacked.num_docs,
+            total_docs=stacked.num_docs,
+        )
+        plan = self._plan(ctx, stacked)
+        cols, valid = stacked.to_device(self.mesh, self.axis, plan.needed_columns)
+        result = self._run(ctx, plan, stacked, cols, valid, stats)
+        out = reduce_mod.reduce_results(ctx, [result], stats)
+        out.stats.time_ms = (time.perf_counter() - t0) * 1000
+        return out
+
+    # ------------------------------------------------------------------
+    def _plan(self, ctx: QueryContext, stacked) -> _DistPlan:
+        key = (ctx.fingerprint(), stacked.signature(), self.axis, self.num_devices)
+        cached = self._plan_cache.get(key)
+        if cached is not None:
+            return cached
+        plan = self._build_plan(ctx, stacked)
+        self._plan_cache[key] = plan
+        return plan
+
+    def _build_plan(self, ctx: QueryContext, stacked) -> _DistPlan:
+        axis = self.axis
+        ndev = self.num_devices
+        local_shards = stacked.num_shards // ndev
+        local_rows = local_shards * stacked.docs_per_shard
+        view = _ShardView(stacked, local_rows)
+
+        fc = FilterCompiler(view, ctx.null_handling)
+        filter_fn = fc.compile(ctx.filter)
+        agg_specs = list(ctx.aggregations)
+        aggs = [get_agg_function(a.function) for a in agg_specs]
+        agg_filter_fns = [fc.compile(s.filter) if s.filter is not None else None for s in agg_specs]
+
+        if ctx.is_aggregate and not ctx.group_by:
+            kind = "aggregation"
+            group_dims: List[GroupDim] = []
+            num_groups = 0
+        elif ctx.group_by:
+            group_dims = [_group_dim(g, view, ctx.null_handling) for g in ctx.group_by]
+            num_groups = 1
+            for gd in group_dims:
+                num_groups *= max(1, gd.cardinality)
+            kind = "groupby_dense" if num_groups <= ctx.max_dense_groups else "groupby_sparse"
+        else:
+            kind = "selection"
+            group_dims = []
+            num_groups = 0
+
+        null_handling = ctx.null_handling
+
+        def _flat(cols):
+            out = {}
+            for name, entry in cols.items():
+                e = {}
+                for k, v in entry.items():
+                    e[k] = v.reshape(-1) if k in ("codes", "values", "nulls") else v
+                out[name] = e
+            return out
+
+        def _agg_inputs(cols, params, base_mask):
+            out = []
+            for spec, fn, ffn in zip(agg_specs, aggs, agg_filter_fns):
+                mask = base_mask
+                if ffn is not None:
+                    ft, _ = ffn(cols, params)
+                    mask = mask & ft
+                if spec.expr is None:
+                    vals = mask
+                elif fn.name == "count" and spec.expr.is_column:
+                    vals = mask
+                    c = stacked.column(spec.expr.op)
+                    if c.nulls is not None and null_handling:
+                        mask = mask & ~cols[spec.expr.op]["nulls"]
+                else:
+                    vals, nulls = eval_expr(spec.expr, view, cols)
+                    vals = as_row_array(vals, mask.shape)
+                    if nulls is not None and null_handling:
+                        mask = mask & ~nulls
+                out.append((vals, mask))
+            return out
+
+        def _group_key(cols):
+            key = None
+            for gd in group_dims:
+                if gd.kind == "dict":
+                    code = cols[gd.name]["codes"].astype(jnp.int32)
+                else:
+                    v = cols[gd.name]["values"]
+                    code = (v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int32)
+                key = code if key is None else key * np.int32(gd.cardinality) + code
+            return key
+
+        if kind == "aggregation":
+
+            def shard_kernel(cols, valid, params):
+                cols = _flat(cols)
+                tmask, _ = filter_fn(cols, params)
+                tmask = tmask & valid.reshape(-1)
+                partials = [fn.partial(v, m) for fn, (v, m) in zip(aggs, _agg_inputs(cols, params, tmask))]
+                return [
+                    {f: _psum_field(f, x, axis) for f, x in p.items()} for p in partials
+                ]
+
+            out_specs = P()
+
+        elif kind == "groupby_dense":
+
+            def shard_kernel(cols, valid, params):
+                cols = _flat(cols)
+                tmask, _ = filter_fn(cols, params)
+                tmask = tmask & valid.reshape(-1)
+                key = _group_key(cols)
+                presence = lax.psum(ops.group_count(tmask, key, num_groups), axis)
+                partials = [
+                    {f: _psum_field(f, x, axis) for f, x in fn.partial_grouped(v, m, key, num_groups).items()}
+                    for fn, (v, m) in zip(aggs, _agg_inputs(cols, params, tmask))
+                ]
+                return presence, partials
+
+            out_specs = P()
+
+        elif kind == "groupby_sparse":
+
+            def shard_kernel(cols, valid, params):
+                cols = _flat(cols)
+                tmask, _ = filter_fn(cols, params)
+                tmask = tmask & valid.reshape(-1)
+                codes = []
+                for gd in group_dims:
+                    if gd.kind == "dict":
+                        codes.append(cols[gd.name]["codes"].astype(jnp.int32))
+                    else:
+                        v = cols[gd.name]["values"]
+                        codes.append((v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int32))
+                inputs = _agg_inputs(cols, params, tmask)
+                # broadcast scalar vals (COUNT) to full length for host gather
+                inputs = [
+                    (jnp.broadcast_to(v, tmask.shape) if getattr(v, "ndim", 0) == 0 else v, m)
+                    for v, m in inputs
+                ]
+                return tmask, codes, inputs
+
+            out_specs = P(self.axis)
+
+        else:  # selection
+
+            def shard_kernel(cols, valid, params):
+                cols = _flat(cols)
+                tmask, _ = filter_fn(cols, params)
+                return tmask & valid.reshape(-1)
+
+            out_specs = P(self.axis)
+
+        # in_specs matching the pytrees: row arrays shard on the leading axis,
+        # dictionaries and params replicate.
+        def _col_specs(cols):
+            out = {}
+            for name, entry in cols.items():
+                out[name] = {k: (P(axis, None) if k in ("codes", "values", "nulls") else P()) for k in entry}
+            return out
+
+        select_columns: List[str] = []
+        if kind == "selection":
+            for s in ctx.select_list:
+                if isinstance(s, Expr) and s.is_column:
+                    if s.op == "*":
+                        select_columns.extend(stacked.schema.column_names)
+                    else:
+                        select_columns.append(s.op)
+                else:
+                    raise NotImplementedError(f"selection expression {s} not yet supported")
+
+        mesh = self.mesh
+
+        def run(cols, valid, params):
+            kern = jax.shard_map(
+                shard_kernel,
+                mesh=mesh,
+                in_specs=(_col_specs(cols), P(axis, None), jax.tree.map(lambda _: P(), params)),
+                out_specs=out_specs,
+                check_vma=False,
+            )
+            return kern(cols, valid, params)
+
+        fn = jax.jit(run)
+
+        needed = sse_executor_needed_columns(ctx, stacked)
+        return _DistPlan(
+            kind=kind,
+            fn=fn,
+            params=fc.params,
+            needed_columns=needed,
+            aggs=aggs,
+            group_dims=group_dims,
+            num_groups=num_groups,
+            select_columns=select_columns,
+        )
+
+    # ------------------------------------------------------------------
+    def _run(self, ctx, plan: _DistPlan, stacked, cols, valid, stats: ExecutionStats):
+        params = {k: jax.device_put(v, NamedSharding(self.mesh, P())) for k, v in plan.params.items()}
+
+        if plan.kind == "aggregation":
+            partials = jax.device_get(plan.fn(cols, valid, params))
+            return AggSegmentResult(partials=partials)
+
+        if plan.kind == "groupby_dense":
+            presence, partials = jax.device_get(plan.fn(cols, valid, params))
+            presence = np.asarray(presence)
+            dense = DenseGroupData(
+                presence=presence,
+                partials=partials,
+                key_space=tuple(
+                    ("dict", gd.name, gd.dictionary.fingerprint(), gd.null_code)
+                    if gd.kind == "dict"
+                    else ("rawint", gd.name, gd.base, gd.cardinality)
+                    for gd in plan.group_dims
+                ),
+                group_dims=plan.group_dims,
+            )
+            shim = SimpleNamespace(group_dims=plan.group_dims, aggs=plan.aggs)
+            keys, sliced = sse_executor._dense_to_present(shim, presence, partials, ctx.num_groups_limit)
+            stats.num_groups = len(keys[0]) if keys else 0
+            return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense)
+
+        if plan.kind == "groupby_sparse":
+            tmask, codes, inputs = jax.device_get(plan.fn(cols, valid, params))
+            shim = SimpleNamespace(group_dims=plan.group_dims, aggs=plan.aggs)
+            res = sse_executor._host_sparse_groupby(shim, tmask, codes, inputs, ctx.num_groups_limit)
+            stats.num_groups = len(res.keys[0]) if res.keys else 0
+            return res
+
+        # selection
+        tmask = np.asarray(jax.device_get(plan.fn(cols, valid, params)))
+        return self._gather_selection(ctx, plan, stacked, tmask)
+
+    def _gather_selection(self, ctx, plan: _DistPlan, stacked, tmask: np.ndarray) -> SelectionSegmentResult:
+        docids = np.nonzero(tmask.reshape(-1))[0]
+        want = ctx.offset + ctx.limit
+        if ctx.order_by:
+            for ob in ctx.order_by:
+                if not ob.expr.is_column:
+                    raise NotImplementedError("selection ORDER BY supports bare columns only")
+            if len(docids) > want:
+                # Codes are GLOBAL sort ranks here (one shared dictionary), so
+                # a numeric lexsort on codes is a correct global top-k.
+                lex_keys: List[np.ndarray] = []
+                for ob in reversed(ctx.order_by):
+                    c = stacked.column(ob.expr.op)
+                    key, null_rank = sse_executor.order_key_arrays(
+                        c.codes.reshape(-1) if c.codes is not None else None,
+                        c.values.reshape(-1) if c.values is not None else None,
+                        c.nulls.reshape(-1) if c.nulls is not None else None,
+                        docids, ob.ascending, ob.nulls_last,
+                    )
+                    lex_keys.append(key)
+                    if null_rank is not None:
+                        lex_keys.append(null_rank)
+                order = np.lexsort(tuple(lex_keys))[:want]
+                docids = docids[order]
+        else:
+            docids = docids[:want]
+
+        arrays: Dict[str, np.ndarray] = {}
+
+        def _decoded(name: str) -> np.ndarray:
+            c = stacked.column(name)
+            vals = stacked.decoded_flat(name)[docids]
+            if c.nulls is not None and ctx.null_handling:
+                vals = np.asarray(vals, dtype=object)
+                vals[c.nulls.reshape(-1)[docids]] = None
+            return vals
+
+        for name in plan.select_columns:
+            arrays[name] = _decoded(name)
+        for i, ob in enumerate(ctx.order_by):
+            arrays[f"__ord{i}"] = _decoded(ob.expr.op)
+        cols_out = plan.select_columns + [f"__ord{i}" for i in range(len(ctx.order_by))]
+        return SelectionSegmentResult(columns=cols_out, arrays=arrays)
+
+
+def sse_executor_needed_columns(ctx: QueryContext, stacked) -> List[str]:
+    """Column set the kernel touches (planner._needed_columns against the
+    stacked facade)."""
+    from pinot_tpu.query.planner import _needed_columns
+
+    view = SimpleNamespace(schema=stacked.schema)
+    return _needed_columns(ctx, view)

@@ -1,0 +1,44 @@
+"""Device mesh helpers.
+
+Reference parity: the scatter axis of Pinot's deployment — segments spread
+over servers, replicas over replica-groups (SURVEY.md 2.5).  TPU-native form:
+a jax.sharding.Mesh whose axes name the parallelism strategies:
+
+  seg      - horizontal data partitioning (scatter-gather analog): shards of
+             the stacked table, combined in-graph by psum over ICI.
+  replica  - replica groups for QPS scaling: the same data resident on R
+             sub-meshes; the router (cluster/broker) picks one per query.
+
+A single-host v5e-8 gives an 8-wide "seg" axis; multi-host pods extend the
+same mesh over DCN transparently through jax's global device view.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def default_mesh(axis: str = "seg", num_devices: Optional[int] = None):
+    """1-D mesh over all (or the first N) local devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def replica_mesh(num_replicas: int, axis_seg: str = "seg", axis_rep: str = "replica"):
+    """2-D (replica, seg) mesh: data replicated across axis_rep, sharded
+    across axis_seg (the replica-group serving topology)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = len(devs)
+    if n % num_replicas:
+        raise ValueError(f"{n} devices not divisible into {num_replicas} replicas")
+    arr = np.asarray(devs).reshape(num_replicas, n // num_replicas)
+    return Mesh(arr, (axis_rep, axis_seg))

@@ -115,7 +115,7 @@ def execute_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
             key_space=_key_space_id(plan),
             group_dims=plan.group_dims,
         )
-        keys, sliced = _dense_to_present(plan, presence, partials)
+        keys, sliced = _dense_to_present(plan, presence, partials, ctx.num_groups_limit)
         stats.num_groups = len(keys[0]) if keys else 0
         return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense), stats
 
@@ -140,20 +140,18 @@ def _key_space_id(plan) -> Tuple:
     return tuple(parts)
 
 
-def _dense_to_present(plan, presence: np.ndarray, partials) -> Tuple[List[np.ndarray], List[Dict]]:
-    """Dense table -> (decoded keys, partials) for present groups only."""
+def _dense_to_present(
+    plan, presence: np.ndarray, partials, num_groups_limit: Optional[int] = None
+) -> Tuple[List[np.ndarray], List[Dict]]:
+    """Dense table -> (decoded keys, partials) for present groups only.
+
+    num_groups_limit caps TRACKED groups (the numGroupsLimit safety valve,
+    InstancePlanMakerImplV2.java:100-120) — lowest packed keys win, matching
+    the sparse path's documented deterministic trim."""
     present = np.nonzero(presence > 0)[0]
-    keys: List[np.ndarray] = []
-    # unravel composite key: dims were packed most-significant-first
-    strides = []
-    acc = 1
-    for gd in reversed(plan.group_dims):
-        strides.append(acc)
-        acc *= gd.cardinality
-    strides = list(reversed(strides))
-    for gd, stride in zip(plan.group_dims, strides):
-        codes = (present // stride) % gd.cardinality
-        keys.append(gd.decode(codes.astype(np.int64)))
+    if num_groups_limit is not None and len(present) > num_groups_limit:
+        present = present[:num_groups_limit]
+    keys = planner.decode_packed_keys(plan.group_dims, present)
     sliced = [{f: np.asarray(arr)[present] for f, arr in p.items()} for p in partials]
     return keys, sliced
 
@@ -179,15 +177,7 @@ def _host_sparse_groupby(plan, tmask, codes, inputs, num_groups_limit: int) -> G
         inverse = inverse[keep]
         uniq = uniq[:num_groups_limit]
     n_groups = len(uniq)
-    keys: List[np.ndarray] = []
-    strides = []
-    acc = 1
-    for gd in reversed(plan.group_dims):
-        strides.append(acc)
-        acc *= gd.cardinality
-    strides = list(reversed(strides))
-    for gd, stride in zip(plan.group_dims, strides):
-        keys.append(gd.decode(((uniq // stride) % gd.cardinality).astype(np.int64)))
+    keys = planner.decode_packed_keys(plan.group_dims, uniq)
     partials: List[Dict[str, np.ndarray]] = []
     for fn, (vals, mask) in zip(plan.aggs, inputs):
         vals = np.asarray(vals)
@@ -195,10 +185,7 @@ def _host_sparse_groupby(plan, tmask, codes, inputs, num_groups_limit: int) -> G
         v = vals[sel] if vals.ndim else np.broadcast_to(vals, (len(sel),))
         p: Dict[str, np.ndarray] = {}
         # reconstruct the same fields the device path produces, via FIELD_COMBINE
-        proto = fn.partial(  # tiny probe to learn field names
-            np.zeros(1, dtype=np.float64), np.zeros(1, dtype=bool)
-        )
-        for fname in proto:
+        for fname in fn.fields:
             if FIELD_COMBINE[fname] == "add":
                 if fname == "count":
                     p[fname] = np.bincount(inverse, weights=mask.astype(np.float64), minlength=n_groups).astype(np.int64)
@@ -266,19 +253,32 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
     return SelectionSegmentResult(columns=cols, arrays=arrays)
 
 
-def _local_order_key(segment: ImmutableSegment, col: str, docids: np.ndarray, ascending: bool, nulls_last: bool):
-    """(value_key, null_rank) keeping integer dtypes intact (no float64 cast:
-    LONG values above 2^53 must not collide)."""
-    c = segment.column(col)
-    if c.codes is not None:
-        key = np.asarray(c.codes)[docids].astype(np.int64)
+def order_key_arrays(
+    codes: Optional[np.ndarray],
+    values: Optional[np.ndarray],
+    nulls: Optional[np.ndarray],
+    docids: np.ndarray,
+    ascending: bool,
+    nulls_last: bool,
+):
+    """(value_key, null_rank) lexsort keys for ORDER BY, keeping integer
+    dtypes intact (no float64 cast: LONG values above 2^53 must not collide).
+    Shared by the per-segment selection trim and the distributed gather
+    (codes are sort ranks within their dictionary's key space)."""
+    if codes is not None:
+        key = np.asarray(codes)[docids].astype(np.int64)
     else:
-        key = np.asarray(c.values)[docids]
+        key = np.asarray(values)[docids]
     if not ascending:
         key = -key.astype(np.int64) if np.issubdtype(key.dtype, np.integer) else -key.astype(np.float64)
     null_rank = None
-    if c.nulls is not None:
-        nullm = c.nulls[docids]
+    if nulls is not None:
+        nullm = np.asarray(nulls)[docids]
         null_rank = np.where(nullm, np.int8(1 if nulls_last else -1), np.int8(0))
         key = np.where(nullm, key.dtype.type(0), key)
     return key, null_rank
+
+
+def _local_order_key(segment: ImmutableSegment, col: str, docids: np.ndarray, ascending: bool, nulls_last: bool):
+    c = segment.column(col)
+    return order_key_arrays(c.codes, c.values, c.nulls, docids, ascending, nulls_last)

@@ -236,7 +236,16 @@ class FilterCompiler:
 
         if pt in (PredicateType.IN, PredicateType.NOT_IN):
             key = self._key("set")
-            self.params[key] = np.asarray(sorted(p.values))
+            vals_arr = np.asarray(sorted(p.values))
+            if (
+                np.issubdtype(vals_arr.dtype, np.integer)
+                and vals_arr.dtype.itemsize > 4
+                and len(vals_arr)
+                and np.iinfo(np.int32).min <= vals_arr[0]
+                and vals_arr[-1] <= np.iinfo(np.int32).max
+            ):
+                vals_arr = vals_arr.astype(np.int32)
+            self.params[key] = vals_arr
 
             def eval_in(cols, params, _key=key, _neg=(pt is PredicateType.NOT_IN)):
                 vals, nulls = eval_expr(p.lhs, seg, cols)

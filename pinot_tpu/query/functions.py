@@ -17,9 +17,9 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-import jax
-import jax.numpy as jnp
 import numpy as np
+
+from pinot_tpu import ops
 
 Partial = Dict[str, Any]
 
@@ -58,6 +58,9 @@ class AggFunction:
 
     name: str = ""
     needs_expr: bool = True
+    # static partial field names (keys of partial()/partial_grouped() output);
+    # host paths read this instead of probing with a dummy device call
+    fields: tuple = ()
 
     # -- device: per-segment partials -----------------------------------
     def partial(self, values, mask) -> Partial:
@@ -77,23 +80,16 @@ class AggFunction:
         return np.dtype(np.float64)
 
 
-def _f64(values):
-    return values.astype(jnp.float64)
-
-
-def _seg_sum(vals, keys, num_groups):
-    return jax.ops.segment_sum(vals, keys, num_segments=num_groups)
-
-
 class CountFunction(AggFunction):
     name = "count"
     needs_expr = False  # COUNT(*) — COUNT(col) counts non-null via mask
+    fields = ("count",)
 
     def partial(self, values, mask):
-        return {"count": jnp.sum(mask, dtype=jnp.int64)}
+        return {"count": ops.masked_count(mask)}
 
     def partial_grouped(self, values, mask, keys, num_groups):
-        return {"count": _seg_sum(mask.astype(jnp.int64), keys, num_groups)}
+        return {"count": ops.group_count(mask, keys, num_groups)}
 
     def merge(self, a, b):
         return {"count": a["count"] + b["count"]}
@@ -109,17 +105,15 @@ class SumFunction(AggFunction):
     """Carries (sum, count) so SUM over zero matching rows is SQL NULL."""
 
     name = "sum"
+    fields = ("sum", "count")
 
     def partial(self, values, mask):
-        return {
-            "sum": jnp.sum(jnp.where(mask, _f64(values), 0.0)),
-            "count": jnp.sum(mask, dtype=jnp.int64),
-        }
+        return {"sum": ops.masked_sum(values, mask), "count": ops.masked_count(mask)}
 
     def partial_grouped(self, values, mask, keys, num_groups):
         return {
-            "sum": _seg_sum(jnp.where(mask, _f64(values), 0.0), keys, num_groups),
-            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+            "sum": ops.group_sum(values, mask, keys, num_groups),
+            "count": ops.group_count(mask, keys, num_groups),
         }
 
     def merge(self, a, b):
@@ -131,18 +125,15 @@ class SumFunction(AggFunction):
 
 class MinFunction(AggFunction):
     name = "min"
+    fields = ("min", "count")
 
     def partial(self, values, mask):
-        return {
-            "min": jnp.min(jnp.where(mask, _f64(values), _POS_INF)),
-            "count": jnp.sum(mask, dtype=jnp.int64),
-        }
+        return {"min": ops.masked_min(values, mask), "count": ops.masked_count(mask)}
 
     def partial_grouped(self, values, mask, keys, num_groups):
-        v = jnp.where(mask, _f64(values), _POS_INF)
         return {
-            "min": jnp.full((num_groups,), _POS_INF, dtype=jnp.float64).at[keys].min(v),
-            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+            "min": ops.group_min(values, mask, keys, num_groups),
+            "count": ops.group_count(mask, keys, num_groups),
         }
 
     def merge(self, a, b):
@@ -154,18 +145,15 @@ class MinFunction(AggFunction):
 
 class MaxFunction(AggFunction):
     name = "max"
+    fields = ("max", "count")
 
     def partial(self, values, mask):
-        return {
-            "max": jnp.max(jnp.where(mask, _f64(values), _NEG_INF)),
-            "count": jnp.sum(mask, dtype=jnp.int64),
-        }
+        return {"max": ops.masked_max(values, mask), "count": ops.masked_count(mask)}
 
     def partial_grouped(self, values, mask, keys, num_groups):
-        v = jnp.where(mask, _f64(values), _NEG_INF)
         return {
-            "max": jnp.full((num_groups,), _NEG_INF, dtype=jnp.float64).at[keys].max(v),
-            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+            "max": ops.group_max(values, mask, keys, num_groups),
+            "count": ops.group_count(mask, keys, num_groups),
         }
 
     def merge(self, a, b):
@@ -179,17 +167,15 @@ class AvgFunction(AggFunction):
     """Carries (sum, count) — Pinot's AvgPair intermediate result."""
 
     name = "avg"
+    fields = ("sum", "count")
 
     def partial(self, values, mask):
-        return {
-            "sum": jnp.sum(jnp.where(mask, _f64(values), 0.0)),
-            "count": jnp.sum(mask, dtype=jnp.int64),
-        }
+        return {"sum": ops.masked_sum(values, mask), "count": ops.masked_count(mask)}
 
     def partial_grouped(self, values, mask, keys, num_groups):
         return {
-            "sum": _seg_sum(jnp.where(mask, _f64(values), 0.0), keys, num_groups),
-            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+            "sum": ops.group_sum(values, mask, keys, num_groups),
+            "count": ops.group_count(mask, keys, num_groups),
         }
 
     def merge(self, a, b):
@@ -205,21 +191,20 @@ class MinMaxRangeFunction(AggFunction):
     """MINMAXRANGE = max - min (Pinot MinMaxRangeAggregationFunction)."""
 
     name = "minmaxrange"
+    fields = ("min", "max", "count")
 
     def partial(self, values, mask):
-        v = _f64(values)
         return {
-            "min": jnp.min(jnp.where(mask, v, _POS_INF)),
-            "max": jnp.max(jnp.where(mask, v, _NEG_INF)),
-            "count": jnp.sum(mask, dtype=jnp.int64),
+            "min": ops.masked_min(values, mask),
+            "max": ops.masked_max(values, mask),
+            "count": ops.masked_count(mask),
         }
 
     def partial_grouped(self, values, mask, keys, num_groups):
-        v = _f64(values)
         return {
-            "min": jnp.full((num_groups,), _POS_INF, dtype=jnp.float64).at[keys].min(jnp.where(mask, v, _POS_INF)),
-            "max": jnp.full((num_groups,), _NEG_INF, dtype=jnp.float64).at[keys].max(jnp.where(mask, v, _NEG_INF)),
-            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
+            "min": ops.group_min(values, mask, keys, num_groups),
+            "max": ops.group_max(values, mask, keys, num_groups),
+            "count": ops.group_count(mask, keys, num_groups),
         }
 
     def merge(self, a, b):
@@ -239,21 +224,20 @@ class SumOfSquaresFunction(AggFunction):
     carries count/sum/sumOfSquares the same way)."""
 
     name = "_sumsq"
+    fields = ("count", "sum", "sumsq")
 
     def partial(self, values, mask):
-        v = _f64(values)
         return {
-            "count": jnp.sum(mask, dtype=jnp.int64),
-            "sum": jnp.sum(jnp.where(mask, v, 0.0)),
-            "sumsq": jnp.sum(jnp.where(mask, v * v, 0.0)),
+            "count": ops.masked_count(mask),
+            "sum": ops.masked_sum(values, mask),
+            "sumsq": ops.masked_sum_sq(values, mask),
         }
 
     def partial_grouped(self, values, mask, keys, num_groups):
-        v = _f64(values)
         return {
-            "count": _seg_sum(mask.astype(jnp.int64), keys, num_groups),
-            "sum": _seg_sum(jnp.where(mask, v, 0.0), keys, num_groups),
-            "sumsq": _seg_sum(jnp.where(mask, v * v, 0.0), keys, num_groups),
+            "count": ops.group_count(mask, keys, num_groups),
+            "sum": ops.group_sum(values, mask, keys, num_groups),
+            "sumsq": ops.group_sum_sq(values, mask, keys, num_groups),
         }
 
     def merge(self, a, b):
@@ -324,6 +308,10 @@ _REGISTRY["var_pop"] = _REGISTRY["variance"]
 _REGISTRY["var_samp"] = _REGISTRY["varsamp"]
 _REGISTRY["stddev_pop"] = _REGISTRY["stddev"]
 _REGISTRY["stddev_samp"] = _REGISTRY["stddevsamp"]
+
+
+def is_agg_function(name: str) -> bool:
+    return name.lower() in _REGISTRY
 
 
 def get_agg_function(name: str) -> AggFunction:

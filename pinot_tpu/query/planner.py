@@ -30,10 +30,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pinot_tpu import ops
 from pinot_tpu.query.filter import FilterCompiler
 from pinot_tpu.query.functions import AggFunction, get_agg_function
 from pinot_tpu.query.ir import AggregationSpec, Expr, QueryContext
-from pinot_tpu.query.transform import eval_expr
+from pinot_tpu.query.transform import as_row_array, eval_expr
 from pinot_tpu.segment.segment import ImmutableSegment
 from pinot_tpu.spi.schema import DataType
 
@@ -61,6 +62,27 @@ class GroupDim:
             vals = np.asarray(vals, dtype=object)
             vals[np.asarray(codes) == self.null_code] = None
         return vals
+
+
+def group_strides(group_dims: List["GroupDim"]) -> List[int]:
+    """Strides of the packed composite group key (most-significant-first, the
+    layout _group_key produces).  Single source of truth for key packing —
+    dense decode, sparse host groupby and reduce all unravel through here."""
+    strides: List[int] = []
+    acc = 1
+    for gd in reversed(group_dims):
+        strides.append(acc)
+        acc *= gd.cardinality
+    return list(reversed(strides))
+
+
+def decode_packed_keys(group_dims: List["GroupDim"], packed: np.ndarray) -> List[np.ndarray]:
+    """Packed composite keys -> per-dimension decoded value arrays."""
+    packed = np.asarray(packed)
+    return [
+        gd.decode(((packed // stride) % gd.cardinality).astype(np.int64))
+        for gd, stride in zip(group_dims, group_strides(group_dims))
+    ]
 
 
 @dataclass
@@ -231,6 +253,7 @@ def _build_plan(
                     mask = mask & ~cols[spec.expr.op]["nulls"]
             else:
                 vals, nulls = eval_expr(spec.expr, segment, cols)
+                vals = as_row_array(vals, mask.shape)
                 if nulls is not None and null_handling:
                     mask = mask & ~nulls
             out.append((vals, mask))
@@ -242,8 +265,9 @@ def _build_plan(
             if gd.kind == "dict":
                 code = cols[gd.name]["codes"].astype(jnp.int32)
             else:
-                base = jnp.asarray(gd.base)
-                code = (cols[gd.name]["values"] - base).astype(jnp.int32)
+                v = cols[gd.name]["values"]
+                # subtract in storage dtype (np scalar: no x64 promotion)
+                code = (v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int32)
             key = code if key is None else key * np.int32(gd.cardinality) + code
         return key
 
@@ -258,7 +282,7 @@ def _build_plan(
         def kernel(cols, params):
             tmask, _ = filter_fn(cols, params)
             key = _group_key(cols, params)
-            presence = jax.ops.segment_sum(tmask.astype(jnp.int32), key, num_segments=num_groups)
+            presence = ops.group_count(tmask, key, num_groups)
             partials = [
                 fn.partial_grouped(vals, mask, key, num_groups)
                 for fn, (vals, mask) in zip(aggs, _agg_inputs(cols, params, tmask))
@@ -276,7 +300,8 @@ def _build_plan(
                 if gd.kind == "dict":
                     codes.append(cols[gd.name]["codes"].astype(jnp.int32))
                 else:
-                    codes.append((cols[gd.name]["values"] - jnp.asarray(gd.base)).astype(jnp.int32))
+                    v = cols[gd.name]["values"]
+                    codes.append((v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int32))
             inputs = _agg_inputs(cols, params, tmask)
             return tmask, codes, inputs
 

@@ -122,6 +122,9 @@ def _reduce_groupby(ctx: QueryContext, results: List[GroupBySegmentResult], stat
         if spec.filter is None and not spec.literal_args:
             call = Expr.call(spec.function, *([spec.expr] if spec.expr else []))
             env.setdefault(call.fingerprint(), f)
+            if spec.expr is None:
+                # `count(*)` written explicitly (parser form)
+                env.setdefault(Expr.call(spec.function, Expr.col("*")).fingerprint(), f)
 
     # HAVING
     n = len(keys[0]) if keys else 0
@@ -154,13 +157,9 @@ def _ident_like(field: str, arr: np.ndarray):
 
 
 def _decode_dense_keys(group_dims, present: np.ndarray) -> List[np.ndarray]:
-    strides = []
-    acc = 1
-    for gd in reversed(group_dims):
-        strides.append(acc)
-        acc *= gd.cardinality
-    strides = list(reversed(strides))
-    return [gd.decode(((present // st) % gd.cardinality).astype(np.int64)) for gd, st in zip(group_dims, strides)]
+    from pinot_tpu.query.planner import decode_packed_keys
+
+    return decode_packed_keys(group_dims, present)
 
 
 def _hash_merge(results: List[GroupBySegmentResult], aggs) -> Tuple[List[np.ndarray], List[Dict[str, np.ndarray]]]:
@@ -197,6 +196,10 @@ def _reduce_selection(ctx: QueryContext, results: List[SelectionSegmentResult], 
     if not results:
         return ResultTable(columns=out_names, rows=[], stats=stats)
     cols = results[0].columns
+    if "*" in out_names:
+        # SELECT *: label with the actual gathered columns so dataSchema
+        # matches the row arity
+        out_names = [c for c in cols if not c.startswith("__ord")]
     arrays = {
         c: np.concatenate([np.asarray(r.arrays[c], dtype=object) for r in results])
         if len(results) > 1
@@ -288,18 +291,25 @@ def _eval_host_filter(node: FilterNode, env: Dict[str, np.ndarray], n: int) -> n
     if fp not in env:
         raise ValueError(f"HAVING references {p.lhs}, which is not in the select/group list")
     vals = env[fp]
+
+    def isnull(v) -> bool:
+        # NULL aggregates arrive as np.nan here (converted to None only at
+        # _scalar); SQL 3VL: any comparison with NULL excludes the group.
+        return v is None or (isinstance(v, (float, np.floating)) and math.isnan(v))
+
     if p.ptype is PredicateType.EQ:
-        return np.asarray([v == p.values[0] for v in vals], dtype=bool)
+        return np.asarray([not isnull(v) and v == p.values[0] for v in vals], dtype=bool)
     if p.ptype is PredicateType.NEQ:
-        return np.asarray([v is not None and v != p.values[0] for v in vals], dtype=bool)
+        return np.asarray([not isnull(v) and v != p.values[0] for v in vals], dtype=bool)
     if p.ptype in (PredicateType.IN, PredicateType.NOT_IN):
         s = set(p.values)
-        m = np.asarray([v in s for v in vals], dtype=bool)
-        return ~m if p.ptype is PredicateType.NOT_IN else m
+        if p.ptype is PredicateType.IN:
+            return np.asarray([not isnull(v) and v in s for v in vals], dtype=bool)
+        return np.asarray([not isnull(v) and v not in s for v in vals], dtype=bool)
     if p.ptype is PredicateType.RANGE:
         m = np.ones(n, dtype=bool)
         for i, v in enumerate(vals):
-            if v is None:
+            if isnull(v):
                 m[i] = False
                 continue
             if p.lower is not None and not (v >= p.lower if p.lower_inclusive else v > p.lower):

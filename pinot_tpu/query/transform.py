@@ -82,7 +82,10 @@ def eval_expr(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
     if expr.kind is ExprKind.COLUMN:
         return column_values(expr.op, segment, cols)
     if expr.kind is ExprKind.LITERAL:
-        return jnp.asarray(expr.value), None
+        # Python scalars stay weak-typed: arithmetic keeps the column's dtype
+        # (jnp.asarray would mint an int64/f64 under x64 and force emulated
+        # 64-bit elementwise ops on TPU).
+        return expr.value, None
     op = expr.op
     if op in _BINARY and len(expr.args) == 2:
         (a, na) = eval_expr(expr.args[0], segment, cols)
@@ -92,7 +95,7 @@ def eval_expr(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
         (a, na) = eval_expr(expr.args[0], segment, cols)
         (b, nb) = eval_expr(expr.args[1], segment, cols)
         # SQL divide: always double (Pinot DivisionTransformFunction)
-        return a.astype(jnp.float64) / b.astype(jnp.float64), _or_masks(na, nb)
+        return astype(a, jnp.float64) / astype(b, jnp.float64), _or_masks(na, nb)
     if op in _UNARY and len(expr.args) == 1:
         (a, na) = eval_expr(expr.args[0], segment, cols)
         return _UNARY[op](a), na
@@ -102,5 +105,21 @@ def eval_expr(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
         dt = {"INT": jnp.int32, "LONG": jnp.int64, "FLOAT": jnp.float32, "DOUBLE": jnp.float64}.get(target)
         if dt is None:
             raise ValueError(f"unsupported CAST target {target}")
-        return a.astype(dt), na
+        return astype(a, dt), na
     raise ValueError(f"unsupported transform function {op!r} in {expr}")
+
+
+def astype(vals, dt):
+    """dtype cast that also accepts the weak-typed python scalars LITERAL
+    nodes produce (the single normalization point for literal operands)."""
+    if hasattr(vals, "astype"):
+        return vals.astype(dt)
+    return jnp.asarray(vals, dtype=dt)
+
+
+def as_row_array(vals, shape):
+    """Broadcast a weak-typed literal to a row-shaped array; pass arrays
+    through (shared by planner/engine aggregation-input plumbing)."""
+    if hasattr(vals, "astype"):
+        return vals
+    return jnp.full(shape, float(vals), dtype=jnp.float64)

@@ -70,6 +70,25 @@ def _extract_nulls(field, raw: ColumnInput) -> (np.ndarray, Optional[np.ndarray]
     return arr, null_mask
 
 
+def narrow_ints(arr: np.ndarray, nmask: Optional[np.ndarray]) -> np.ndarray:
+    """Store 64-bit integer columns as int32 when the value range fits.
+
+    TPUs have no 64-bit ALU (emulated, ~50x slower) — narrowing at build time
+    makes scans/compares native-speed and halves HBM traffic.  The logical
+    type stays LONG; only storage narrows.  Columns with nulls keep their
+    dtype (the null placeholder is int64-min)."""
+    if (
+        nmask is None
+        and np.issubdtype(arr.dtype, np.integer)
+        and arr.dtype.itemsize > 4
+        and len(arr)
+        and np.iinfo(np.int32).min <= arr.min()
+        and arr.max() <= np.iinfo(np.int32).max
+    ):
+        return arr.astype(np.int32)
+    return arr
+
+
 def build_segment(
     schema: Schema,
     data: Dict[str, ColumnInput],
@@ -127,7 +146,7 @@ def build_segment(
                 raise ValueError(f"string column {f.name} requires a dictionary")
             card = int(len(np.unique(arr)))
             stats = collect_stats(f.name, f.data_type, arr, nmask, card, False)
-            columns[f.name] = ColumnData(f.name, f.data_type, None, None, arr, nmask, stats)
+            columns[f.name] = ColumnData(f.name, f.data_type, None, None, narrow_ints(arr, nmask), nmask, stats)
         if f.name in idx_cfg.bloom_filter_columns:
             uniq = columns[f.name].dictionary.values if use_dict else np.unique(arr)
             indexes.setdefault("bloom", {})[f.name] = BloomFilter.build(list(uniq))

@@ -15,7 +15,7 @@ Design deltas vs the reference:
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dataclass_field
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -50,7 +50,9 @@ class Dictionary:
     def cardinality(self) -> int:
         return len(self.values)
 
-    _fp_cache: Optional[str] = None
+    # cache slot, not data: excluded from __init__/__eq__/__repr__ so a
+    # poisoned fingerprint cannot be injected via the constructor
+    _fp_cache: Optional[str] = dataclass_field(default=None, init=False, compare=False, repr=False)
 
     def fingerprint(self) -> str:
         """Content hash of the value set — used to detect segments that share
@@ -132,10 +134,22 @@ class Dictionary:
 
     # -- device ----------------------------------------------------------
     def device_values(self) -> Optional[np.ndarray]:
-        """Numeric dictionary values for HBM residency (None for strings)."""
+        """Numeric dictionary values for HBM residency (None for strings).
+
+        64-bit integer dictionaries narrow to int32 when the range fits —
+        TPUs emulate 64-bit ALU ops (see segment/builder.py narrow_ints)."""
         if self.data_type.is_string_like:
             return None
-        return np.asarray(self.values, dtype=self.data_type.np_dtype)
+        vals = np.asarray(self.values, dtype=self.data_type.np_dtype)
+        if (
+            np.issubdtype(vals.dtype, np.integer)
+            and vals.dtype.itemsize > 4
+            and len(vals)
+            and np.iinfo(np.int32).min <= vals[0]
+            and vals[-1] <= np.iinfo(np.int32).max
+        ):
+            return vals.astype(np.int32)
+        return vals
 
     # -- serde (store.py writes these regions) ---------------------------
     def to_regions(self, prefix: str):

@@ -6,10 +6,18 @@ xla_force_host_platform_device_count=8 so tests exercise real Mesh/shard_map
 code without TPU hardware."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the ambient environment may pin JAX_PLATFORMS to real TPU
+# hardware (single chip); tests need the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The ambient sitecustomize pre-imports jax._src, latching JAX_PLATFORMS
+# before this conftest runs — override at the config level too.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

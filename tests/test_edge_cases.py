@@ -1,0 +1,60 @@
+"""Regression tests for review findings: SELECT * schema, HAVING 3VL with
+NULL aggregates, numGroupsLimit on the dense path, literal operands, CAST."""
+import numpy as np
+import pytest
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+from pinot_tpu.sql.parser import SqlParseError, parse_query
+
+
+@pytest.fixture(scope="module")
+def eng():
+    schema = Schema(
+        "t",
+        [
+            FieldSpec("g", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC, nullable=True),
+        ],
+    )
+    e = QueryEngine()
+    e.register_table(schema)
+    data = {"g": np.array(["a", "a", "b", "b", "c", "d"], dtype=object), "v": [1, 2, None, None, 99, 5]}
+    e.add_segment("t", build_segment(schema, data, "s"))
+    return e
+
+
+def test_select_star_columns_match_rows(eng):
+    r = eng.query("SELECT * FROM t LIMIT 3")
+    assert r.columns == ["g", "v"]
+    assert all(len(row) == 2 for row in r.rows)
+
+
+def test_having_3vl_null_aggregate_excluded(eng):
+    # group 'b' has SUM(v) = NULL; SQL 3VL excludes it under <> and NOT IN
+    r = eng.query("SELECT g, SUM(v) FROM t GROUP BY g HAVING SUM(v) <> 99 ORDER BY g LIMIT 10")
+    assert [x[0] for x in r.rows] == ["a", "d"]
+    r2 = eng.query("SELECT g, SUM(v) FROM t GROUP BY g HAVING SUM(v) NOT IN (99) ORDER BY g LIMIT 10")
+    assert [x[0] for x in r2.rows] == ["a", "d"]
+
+
+def test_num_groups_limit_dense_path(eng):
+    r = eng.query("SET numGroupsLimit = 2; SELECT g, COUNT(*) FROM t GROUP BY g LIMIT 10")
+    assert len(r.rows) == 2
+
+
+def test_literal_divisor_and_cast(eng):
+    r = eng.query("SELECT SUM(v / 2), SUM(CAST(v AS DOUBLE)) FROM t")
+    assert r.rows[0][0] == pytest.approx((1 + 2 + 99 + 5) / 2)
+    assert r.rows[0][1] == pytest.approx(107.0)
+
+
+def test_count_distinct_clear_error():
+    with pytest.raises(SqlParseError, match="not supported yet"):
+        parse_query("SELECT COUNT(DISTINCT g) FROM t")
+
+
+def test_sum_of_pure_literal(eng):
+    r = eng.query("SELECT SUM(1) FROM t")
+    assert r.rows[0][0] == 6

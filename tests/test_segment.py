@@ -119,7 +119,10 @@ class TestSegmentBuild:
         assert c.stats.min_value == data["year"].min()
         assert c.stats.max_value == data["year"].max()
         runs = seg.column("runs")
-        assert not runs.has_dictionary and runs.values.dtype == np.int64
+        # LONG storage narrows to int32 when the value range fits (TPU has no
+        # 64-bit ALU; see builder.narrow_ints) — logical type stays LONG
+        assert not runs.has_dictionary and runs.values.dtype == np.int32
+        assert runs.data_type.value == "LONG"
         score = seg.column("score")
         assert score.nulls is not None and score.nulls.sum() > 0
         np.testing.assert_array_equal(seg.column("city").decoded(), data["city"])

@@ -1,0 +1,160 @@
+"""M4 tests: SQL parser -> QueryContext IR, and SQL end-to-end through the
+engine golden-checked against sqlite3 (the BaseQueriesTest+H2 tier shape)."""
+import numpy as np
+import pytest
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.query.ir import (
+    AggregationSpec,
+    Expr,
+    FilterOp,
+    PredicateType,
+)
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.config import TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+from pinot_tpu.sql.parser import SqlParseError, parse_query
+
+from golden import assert_same_rows, sqlite_from_data
+
+
+# ---------------------------------------------------------------------------
+# IR-level
+# ---------------------------------------------------------------------------
+def test_parse_simple_agg():
+    ctx = parse_query("SELECT COUNT(*), SUM(v) FROM t WHERE year > 2000")
+    assert ctx.table == "t"
+    assert ctx.select_list[0] == AggregationSpec("count", None)
+    assert ctx.select_list[1] == AggregationSpec("sum", Expr.col("v"))
+    p = ctx.filter.predicate
+    assert p.ptype is PredicateType.RANGE and p.lower == 2000 and not p.lower_inclusive
+
+
+def test_parse_groupby_having_orderby():
+    ctx = parse_query(
+        "SELECT city, SUM(v) AS total FROM t GROUP BY city "
+        "HAVING SUM(v) > 100 ORDER BY total DESC, city LIMIT 5 OFFSET 2"
+    )
+    assert ctx.group_by == [Expr.col("city")]
+    assert ctx.select_aliases == [None, "total"]
+    assert ctx.having.predicate.lhs == Expr.call("sum", Expr.col("v"))
+    assert ctx.order_by[0].ascending is False
+    assert ctx.order_by[1].ascending is True
+    assert ctx.limit == 5 and ctx.offset == 2
+
+
+def test_parse_boolean_algebra():
+    ctx = parse_query(
+        "SELECT * FROM t WHERE (city = 'sf' OR city = 'nyc') AND NOT year IN (2001, 2002)"
+    )
+    f = ctx.filter
+    assert f.op is FilterOp.AND
+    assert f.children[0].op is FilterOp.OR
+    assert f.children[1].op is FilterOp.NOT
+    assert f.children[1].children[0].predicate.ptype is PredicateType.IN
+
+
+def test_parse_between_like_null():
+    ctx = parse_query(
+        "SELECT v FROM t WHERE year BETWEEN 2001 AND 2003 AND city LIKE 's%' AND price IS NOT NULL"
+    )
+    kids = ctx.filter.children
+    assert kids[0].predicate.ptype is PredicateType.RANGE
+    assert kids[0].predicate.lower == 2001 and kids[0].predicate.upper == 2003
+    assert kids[1].predicate.ptype is PredicateType.LIKE
+    assert kids[2].predicate.ptype is PredicateType.IS_NOT_NULL
+
+
+def test_parse_options_and_literals():
+    ctx = parse_query("SET numGroupsLimit = 1000; SELECT COUNT(*) FROM t LIMIT 3")
+    assert ctx.options["numGroupsLimit"] == 1000
+    assert ctx.limit == 3
+    ctx2 = parse_query("SELECT COUNT(*) FROM t OPTION(timeoutMs=500)")
+    assert ctx2.options["timeoutMs"] == 500
+
+
+def test_parse_arith_and_filtered_agg():
+    ctx = parse_query(
+        "SELECT SUM(v + 1) FILTER (WHERE city = 'sf'), AVG(v * 2) FROM t"
+    )
+    s0 = ctx.select_list[0]
+    assert s0.function == "sum" and s0.filter is not None
+    assert s0.expr == Expr.call("plus", Expr.col("v"), Expr.lit(1))
+    assert ctx.select_list[1].expr == Expr.call("times", Expr.col("v"), Expr.lit(2))
+
+
+def test_parse_constant_fold():
+    ctx = parse_query("SELECT COUNT(*) FROM t WHERE v > 10 * 2 + 5")
+    assert ctx.filter.predicate.lower == 25
+
+
+def test_parse_errors():
+    with pytest.raises(SqlParseError):
+        parse_query("SELECT FROM t")
+    with pytest.raises(SqlParseError):
+        parse_query("SELECT * t")
+    with pytest.raises(SqlParseError):
+        parse_query("SELECT * FROM t WHERE")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end SQL vs sqlite
+# ---------------------------------------------------------------------------
+N = 4000
+CITIES = ["sf", "nyc", "chi", "la", "sea"]
+
+
+@pytest.fixture(scope="module")
+def env():
+    rng = np.random.default_rng(7)
+    schema = Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("year", DataType.INT),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+    engine = QueryEngine()
+    engine.register_table(schema, TableConfig("t"))
+    all_data = {k: [] for k in ("city", "year", "v")}
+    for seed in (1, 2):
+        data = {
+            "city": rng.choice(CITIES, N).astype(object),
+            "year": rng.integers(2000, 2010, N).astype(np.int32),
+            "v": rng.integers(0, 1000, N),
+        }
+        seg = build_segment(schema, data, f"s{seed}")
+        engine.add_segment("t", seg)
+        for k in all_data:
+            all_data[k].append(data[k])
+    merged = {k: np.concatenate(v) for k, v in all_data.items()}
+    conn = sqlite_from_data("t", merged)
+    return engine, conn
+
+
+QUERIES = [
+    "SELECT COUNT(*) FROM t",
+    "SELECT COUNT(*), SUM(v), MIN(v), MAX(v), AVG(v) FROM t WHERE year >= 2005",
+    "SELECT city, SUM(v) FROM t WHERE year BETWEEN 2002 AND 2008 GROUP BY city ORDER BY city LIMIT 20",
+    "SELECT city, year, COUNT(*) FROM t GROUP BY city, year HAVING COUNT(*) > 50 ORDER BY city, year LIMIT 100",
+    "SELECT SUM(v) FROM t WHERE city IN ('sf', 'nyc') AND NOT year = 2003",
+    "SELECT city FROM t WHERE v < 5 ORDER BY city LIMIT 10",
+    "SELECT year, AVG(v) FROM t WHERE city = 'sf' OR city = 'la' GROUP BY year ORDER BY year LIMIT 20",
+]
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_sql_end_to_end(env, sql):
+    engine, conn = env
+    got = engine.query(sql)
+    exp = conn.execute(sql.replace("FILTER (WHERE", "FILTER (WHERE")).fetchall()
+    ordered = "ORDER BY" in sql
+    assert_same_rows(got.rows, exp, ordered=ordered)
+
+
+def test_sql_distinct(env):
+    engine, conn = env
+    got = engine.query("SELECT DISTINCT city FROM t LIMIT 50")
+    exp = conn.execute("SELECT DISTINCT city FROM t LIMIT 50").fetchall()
+    assert_same_rows(got.rows, exp, ordered=False)

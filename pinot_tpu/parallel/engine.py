@@ -30,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from pinot_tpu import ops
 from pinot_tpu.query import executor as sse_executor
 from pinot_tpu.query import reduce as reduce_mod
+from pinot_tpu.query import planner as planner_mod
 from pinot_tpu.query.filter import FilterCompiler
 from pinot_tpu.query.functions import FIELD_COMBINE, get_agg_function
 from pinot_tpu.query.ir import AggregationSpec, Expr, QueryContext
@@ -116,6 +117,7 @@ class DistributedEngine:
 
         t0 = time.perf_counter()
         stacked = self.tables[ctx.table]
+        self._inject_sketch_info(ctx, stacked)
         stats = ExecutionStats(
             num_segments_queried=stacked.num_shards,
             num_segments_processed=stacked.num_shards,
@@ -128,6 +130,25 @@ class DistributedEngine:
         out = reduce_mod.reduce_results(ctx, [result], stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         return out
+
+    @staticmethod
+    def _inject_sketch_info(ctx: QueryContext, stacked) -> None:
+        """Stacked tables are aligned by construction (one dictionary per
+        column); publish that plus global ranges for sketch bindings."""
+        from pinot_tpu.query.functions import for_spec
+
+        for spec in ctx.aggregations:
+            if spec.expr is None or not spec.expr.is_column:
+                continue
+            if not for_spec(spec).needs_binding:
+                continue
+            col = spec.expr.op
+            c = stacked.column(col)
+            ctx.options.setdefault(
+                f"__dictfp__{col}", c.dictionary.fingerprint() if c.has_dictionary else ""
+            )
+            if c.stats.min_value is not None and not c.data_type.is_string_like:
+                ctx.options.setdefault(f"__range__{col}", (c.stats.min_value, c.stats.max_value))
 
     # ------------------------------------------------------------------
     def _plan(self, ctx: QueryContext, stacked) -> _DistPlan:
@@ -149,7 +170,7 @@ class DistributedEngine:
         fc = FilterCompiler(view, ctx.null_handling)
         filter_fn = fc.compile(ctx.filter)
         agg_specs = list(ctx.aggregations)
-        aggs = [get_agg_function(a.function) for a in agg_specs]
+        aggs = planner_mod.bind_aggs(agg_specs, stacked, ctx)
         agg_filter_fns = [fc.compile(s.filter) if s.filter is not None else None for s in agg_specs]
 
         if ctx.is_aggregate and not ctx.group_by:
@@ -166,6 +187,8 @@ class DistributedEngine:
             kind = "selection"
             group_dims = []
             num_groups = 0
+
+        planner_mod.guard_sparse_vector_fields(kind, aggs)
 
         null_handling = ctx.null_handling
 
@@ -187,6 +210,8 @@ class DistributedEngine:
                     mask = mask & ft
                 if spec.expr is None:
                     vals = mask
+                elif fn.needs_codes:
+                    vals, mask = planner_mod.agg_input_codes(spec, fn, view, cols, mask, null_handling)
                 elif fn.name == "count" and spec.expr.is_column:
                     vals = mask
                     c = stacked.column(spec.expr.op)

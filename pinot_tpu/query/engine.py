@@ -48,6 +48,7 @@ class QueryEngine:
     def execute(self, ctx: QueryContext, device=None) -> ResultTable:
         t0 = time.perf_counter()
         state = self.table(ctx.table)
+        self._inject_global_ranges(ctx, state)
         stats = ExecutionStats()
         results = []
         for seg in state.segments:
@@ -63,6 +64,42 @@ class QueryEngine:
         out = reduce_mod.reduce_results(ctx, results, stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         return out
+
+    @staticmethod
+    def _inject_global_ranges(ctx: QueryContext, state: TableState) -> None:
+        """Table-global facts per sketch-aggregated column, injected as ctx
+        options so every segment binds identically:
+          __range__<col>  - global [min, max]: histogram bin edges must be
+                            the same everywhere for partials to add
+          __dictfp__<col> - dictionary-fingerprint consensus; "MIXED" tells
+                            column_binding the code space is NOT shared, so
+                            code-indexed partials must not merge"""
+        from pinot_tpu.query.functions import for_spec
+
+        for spec in ctx.aggregations:
+            if spec.expr is None or not spec.expr.is_column:
+                continue
+            if not for_spec(spec).needs_binding:
+                continue
+            col = spec.expr.op
+            rkey, fkey = f"__range__{col}", f"__dictfp__{col}"
+            if rkey in ctx.options and fkey in ctx.options:
+                continue
+            mins, maxs = [], []
+            fps = set()
+            for seg in state.segments:
+                if col not in seg.columns:
+                    continue
+                c = seg.column(col)
+                fps.add(c.dictionary.fingerprint() if c.has_dictionary else None)
+                if c.stats.min_value is not None and not c.data_type.is_string_like:
+                    mins.append(c.stats.min_value)
+                    maxs.append(c.stats.max_value)
+            if mins:
+                ctx.options.setdefault(rkey, (min(mins), max(maxs)))
+            if fps:
+                only = next(iter(fps)) if len(fps) == 1 else None
+                ctx.options.setdefault(fkey, "MIXED" if len(fps) > 1 else (only or ""))
 
     def query(self, sql: str, device=None) -> ResultTable:
         """SQL front door (CalciteSqlParser analog lives in sql/)."""

@@ -36,6 +36,13 @@ FIELD_COMBINE = {
     "sumsq": "add",
     "min": "min",
     "max": "max",
+    # sketch fields (query/sketches.py): presence bitmaps and HLL registers
+    # union via max; histograms add; bin-range bookkeeping via min/max
+    "present": "max",
+    "hll": "max",
+    "hist": "add",
+    "lo": "min",
+    "hi": "max",
 }
 
 
@@ -61,6 +68,22 @@ class AggFunction:
     # static partial field names (keys of partial()/partial_grouped() output);
     # host paths read this instead of probing with a dummy device call
     fields: tuple = ()
+    # planner feeds dictionary codes / range-offset ints instead of values
+    needs_codes: bool = False
+    # planner must call bind_column() with per-column constants before use
+    needs_binding: bool = False
+    # partial fields are per-group VECTORS (presence/registers/histograms);
+    # such aggs cannot ride the scalar-field host sparse-groupby fallback
+    vector_fields: bool = False
+
+    # -- binding (sketch functions override; see query/sketches.py) ------
+    def with_args(self, literal_args) -> "AggFunction":
+        """Specialize with SQL literal arguments (percentile rank, log2m)."""
+        return self
+
+    def bind_column(self, info) -> "AggFunction":
+        """Bind per-column constants (domain, hash tables, bin ranges)."""
+        return self
 
     # -- device: per-segment partials -----------------------------------
     def partial(self, values, mask) -> Partial:
@@ -319,3 +342,13 @@ def get_agg_function(name: str) -> AggFunction:
     if fn is None:
         raise ValueError(f"unknown aggregation function {name!r} (have {sorted(_REGISTRY)})")
     return fn
+
+
+def for_spec(spec) -> AggFunction:
+    """Registry lookup + literal-arg specialization for one AggregationSpec.
+    (Column binding is planner-side; merge/final never need it.)"""
+    return get_agg_function(spec.function).with_args(spec.literal_args)
+
+
+# Register the sketch family (import at bottom: sketches subclasses AggFunction)
+from pinot_tpu.query import sketches  # noqa: E402,F401

@@ -32,7 +32,7 @@ import numpy as np
 
 from pinot_tpu import ops
 from pinot_tpu.query.filter import FilterCompiler
-from pinot_tpu.query.functions import AggFunction, get_agg_function
+from pinot_tpu.query.functions import AggFunction, for_spec, get_agg_function
 from pinot_tpu.query.ir import AggregationSpec, Expr, QueryContext
 from pinot_tpu.query.transform import as_row_array, eval_expr
 from pinot_tpu.segment.segment import ImmutableSegment
@@ -113,7 +113,9 @@ def _sig_value(v):
     return v.item() if isinstance(v, np.generic) else v
 
 
-def _segment_signature(segment: ImmutableSegment, needed: List[str]) -> Tuple:
+def _segment_signature(
+    segment: ImmutableSegment, needed: List[str], sketch_cols: frozenset = frozenset()
+) -> Tuple:
     sig = [segment.num_docs]
     for name in sorted(needed):
         c = segment.column(name)
@@ -124,6 +126,17 @@ def _segment_signature(segment: ImmutableSegment, needed: List[str]) -> Tuple:
             raw_range = (
                 (_sig_value(c.stats.min_value), _sig_value(c.stats.max_value)) if c.stats.num_docs else (0, 0)
             )
+        # Sketch-bound columns bake DICTIONARY-DERIVED constants (HLL hash
+        # tables, histogram edges) into the compiled kernel as closure
+        # constants — the exact dictionary must be part of the cache key or
+        # a same-shaped segment silently reuses another segment's tables.
+        sketch_extra = None
+        if name in sketch_cols:
+            sketch_extra = (
+                c.dictionary.fingerprint() if c.has_dictionary else None,
+                _sig_value(c.stats.min_value),
+                _sig_value(c.stats.max_value),
+            )
         sig.append(
             (
                 name,
@@ -131,9 +144,29 @@ def _segment_signature(segment: ImmutableSegment, needed: List[str]) -> Tuple:
                 str(c.codes.dtype if c.codes is not None else c.values.dtype),
                 c.nulls is not None,
                 raw_range,
+                sketch_extra,
             )
         )
     return tuple(sig)
+
+
+def sketch_bound_columns(ctx: QueryContext) -> frozenset:
+    """Columns whose sketch bindings bake per-segment constants into kernels."""
+    out = set()
+    for spec in ctx.aggregations:
+        if spec.expr is not None and spec.expr.is_column and for_spec(spec).needs_binding:
+            out.add(spec.expr.op)
+    return frozenset(out)
+
+
+def guard_sparse_vector_fields(kind: str, aggs: List[AggFunction]) -> None:
+    """Vector-partial aggregations (presence/registers/histograms) cannot
+    ride the scalar-field host sparse-groupby fallback."""
+    if kind == "groupby_sparse" and any(fn.vector_fields for fn in aggs):
+        raise NotImplementedError(
+            "sketch aggregations (DISTINCTCOUNT/HLL/PERCENTILE) require the dense "
+            "group path; lower group-key cardinality or raise maxDenseGroups"
+        )
 
 
 def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
@@ -189,9 +222,86 @@ def _group_dim(expr: Expr, segment: ImmutableSegment, null_handling: bool) -> Gr
     )
 
 
+def column_binding(spec, segment, ctx: Optional[QueryContext] = None):
+    """Per-column constants for sketch aggregations (query/sketches.py).
+
+    Alignment resolution: engine-injected options carry the table-global
+    value range ("__range__<col>") and dictionary-fingerprint consensus
+    ("__dictfp__<col>", "MIXED" when segments disagree).  A dict column whose
+    key space is NOT shared across segments must not merge code-indexed
+    partials — numeric columns downgrade to a value-range ("rawint") binding,
+    everything else to "raw" (hash-based sketches only)."""
+    from pinot_tpu.query.sketches import ColumnBinding
+
+    e = spec.expr
+    if e is None or not e.is_column:
+        raise NotImplementedError(f"{spec.function} requires a bare column argument")
+    c = segment.column(e.op)
+    mn, mx = c.stats.min_value, c.stats.max_value
+    aligned = True
+    if ctx is not None:
+        rng = ctx.options.get(f"__range__{e.op}")
+        if rng is not None:
+            mn, mx = rng
+        aligned = ctx.options.get(f"__dictfp__{e.op}", "") != "MIXED"
+    dict_values = c.dictionary.values if c.has_dictionary else None
+    if c.has_dictionary and aligned:
+        return ColumnBinding(
+            "dict", domain=c.dictionary.cardinality, dict_values=dict_values,
+            min_value=mn, max_value=mx,
+        )
+    if c.data_type in (DataType.INT, DataType.LONG, DataType.TIMESTAMP, DataType.BOOLEAN) and mn is not None:
+        rng_width = int(mx) - int(mn) + 1
+        if rng_width <= MAX_DENSE_RAW_INT_RANGE:
+            return ColumnBinding("rawint", domain=rng_width, base=int(mn), min_value=mn, max_value=mx)
+    # dict_values still flow through: value-based host hashing (HLL) stays
+    # correct across misaligned dictionaries
+    return ColumnBinding("raw", dict_values=dict_values, min_value=mn, max_value=mx)
+
+
+def bind_aggs(agg_specs, segment, ctx: QueryContext):
+    """Specialize + column-bind the aggregation functions for one plan."""
+    out = []
+    for spec in agg_specs:
+        fn = for_spec(spec)
+        if fn.needs_binding:
+            fn = fn.bind_column(column_binding(spec, segment, ctx))
+        out.append(fn)
+    return out
+
+
+def agg_input_codes(spec, fn, segment, cols, mask, null_handling: bool):
+    """Kernel-side input for needs_codes aggregations, dispatched on the
+    bound function's input_kind:
+      codes         - dictionary codes (shared key space / per-segment hash
+                      tables index by them)
+      values_offset - decoded numeric values minus the binding's base (a
+                      table-global int range, aligned by construction)
+      values_hash   - raw numeric values, hashed on device (full bit
+                      pattern; see sketches._device_hash_values)"""
+    import jax.numpy as jnp
+
+    from pinot_tpu.query.transform import column_values
+
+    name = spec.expr.op
+    c = segment.column(name)
+    entry = cols[name]
+    if c.nulls is not None and null_handling:
+        mask = mask & ~entry["nulls"]
+    kind = getattr(fn, "input_kind", "codes")
+    if kind == "codes":
+        if not c.has_dictionary:
+            raise ValueError(f"{spec.function} bound to codes but column {name} has no dictionary")
+        return entry["codes"].astype(jnp.int32), mask
+    vals, _ = column_values(name, segment, cols)
+    if kind == "values_offset":
+        return (vals - np.asarray(fn.base, dtype=vals.dtype)).astype(jnp.int32), mask
+    return vals, mask  # values_hash
+
+
 def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     needed = _needed_columns(ctx, segment)
-    key = (ctx.fingerprint(), _segment_signature(segment, needed))
+    key = (ctx.fingerprint(), _segment_signature(segment, needed, sketch_bound_columns(ctx)))
     cached = _PLAN_CACHE.get(key)
     if cached is not None:
         # params are per-segment (dictionary-dependent): rebuild them, reuse fn
@@ -212,8 +322,8 @@ def _build_plan(
     fc = FilterCompiler(segment, null_handling)
     filter_fn = fc.compile(ctx.filter)
 
-    aggs = [get_agg_function(a.function) for a in ctx.aggregations]
     agg_specs = list(ctx.aggregations)
+    aggs = bind_aggs(agg_specs, segment, ctx)
 
     # per-aggregation FILTER(WHERE ...) clauses
     agg_filter_fns: List[Optional[Callable]] = []
@@ -235,6 +345,8 @@ def _build_plan(
         group_dims = []
         num_groups = 0
 
+    guard_sparse_vector_fields(kind, aggs)
+
     def _agg_inputs(cols, params, base_mask):
         """Per-aggregation (values, mask) with null + FILTER handling."""
         out = []
@@ -245,6 +357,8 @@ def _build_plan(
                 mask = mask & ft
             if spec.expr is None:
                 vals = mask  # COUNT(*): values unused
+            elif fn.needs_codes:
+                vals, mask = agg_input_codes(spec, fn, segment, cols, mask, null_handling)
             elif fn.name == "count" and spec.expr.is_column:
                 # COUNT(col) needs only the null mask — works on strings too.
                 vals = mask

@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from pinot_tpu.query.functions import combine_field, get_agg_function
+from pinot_tpu.query.functions import combine_field, field_identity, for_spec
 from pinot_tpu.query.ir import (
     AggregationSpec,
     Expr,
@@ -53,7 +53,7 @@ def reduce_results(ctx: QueryContext, results: List[Any], stats: ExecutionStats)
 # Aggregation-only
 # ---------------------------------------------------------------------------
 def _reduce_aggregation(ctx: QueryContext, results: List[AggSegmentResult], stats: ExecutionStats) -> ResultTable:
-    aggs = [get_agg_function(a.function) for a in ctx.aggregations]
+    aggs = [for_spec(a) for a in ctx.aggregations]
     merged: Optional[List[Dict[str, np.ndarray]]] = None
     for r in results:
         if merged is None:
@@ -83,7 +83,7 @@ def _scalar(v):
 # Group-by
 # ---------------------------------------------------------------------------
 def _reduce_groupby(ctx: QueryContext, results: List[GroupBySegmentResult], stats: ExecutionStats) -> ResultTable:
-    aggs = [get_agg_function(a.function) for a in ctx.aggregations]
+    aggs = [for_spec(a) for a in ctx.aggregations]
     results = [r for r in results if r is not None]
     if not results:
         return ResultTable(columns=ctx.column_names_out(), rows=[], stats=stats)
@@ -149,11 +149,15 @@ def _reduce_groupby(ctx: QueryContext, results: List[GroupBySegmentResult], stat
 
 
 def _ident_like(field: str, arr: np.ndarray):
-    from pinot_tpu.query.functions import field_identity
-
     if field == "count":
         return 0
-    return field_identity(field)
+    ident = field_identity(field)
+    if np.issubdtype(np.asarray(arr).dtype, np.integer):
+        # +-inf identities don't exist for int fields (presence bitmaps, HLL
+        # registers, histograms); use the dtype extremes / zero instead
+        info = np.iinfo(np.asarray(arr).dtype)
+        return {0.0: 0, float("inf"): info.max, float("-inf"): 0}[ident]
+    return ident
 
 
 def _decode_dense_keys(group_dims, present: np.ndarray) -> List[np.ndarray]:

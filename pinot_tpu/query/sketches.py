@@ -1,0 +1,450 @@
+"""Sketch & distinct aggregations: DISTINCTCOUNT, DISTINCTCOUNTHLL, PERCENTILE.
+
+Reference parity: pinot-core's sketch family —
+DistinctCountAggregationFunction (exact, value sets),
+DistinctCountHLLAggregationFunction (HyperLogLog registers),
+PercentileEst/TDigest/KLL (quantile sketches)
+(pinot-core/.../query/aggregation/function/, SURVEY.md 2.2 "Aggregation
+functions": 106 classes, DISTINCTCOUNT(HLL/...)/PERCENTILE(Est/TDigest/KLL)).
+
+TPU re-design — all three become FIXED-SIZE TENSOR partials whose combine is
+elementwise, so they ride the same dense-group-table + psum machinery as SUM:
+
+  * DISTINCTCOUNT (exact): a presence table over the column's code domain
+    (dictionary ids, or range-offset raw ints).  partial field "present"
+    [.., domain] int32 0/1, combine = max (set union).  final = row-sum.
+    Pinot keeps hash sets per group; a bounded-domain bitmap is the exact
+    tensor equivalent (same idea as its RoaringBitmap-based
+    DistinctCountBitmapAggregationFunction).
+  * DISTINCTCOUNTHLL: classic HLL registers [.., m] uint8? kept int32 for
+    psum/pmax friendliness; combine = max (HLL union is register-wise max —
+    exactly FIELD_COMBINE's "max").  Hashes are precomputed host-side over
+    the DICTIONARY (card hashes total, not n) — the same dictionary trick the
+    filter layer uses — or computed on device with a murmur-style finalizer
+    for raw int columns.
+  * PERCENTILE (and the Est/TDigest/KLL names): an equi-width histogram
+    sketch over [lo, hi] taken from column stats; partial "hist" [.., B]
+    additive + "lo"/"hi" scalar fields (min/max combine) to keep merges
+    self-describing.  final interpolates within the hit bin.  Accuracy is
+    (hi-lo)/B — with B=2048 that is tighter than Pinot's default TDigest
+    compression for most distributions, and the partial is mergeable across
+    segments by plain addition (a psum over ICI).
+
+Binding: these functions need per-column constants (domain width, hash
+tables, bin ranges).  `get_agg_function` returns unbound singletons whose
+merge/final are shape-agnostic (reduce side); the planner calls
+`with_args(literal_args)` then `bind_column(info)` to get the kernel-side
+instance (see planner._bind_aggs).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu import ops
+from pinot_tpu.query.functions import AggFunction, register
+
+# Exact distinct-count presence tables are capped at this many cells
+# (groups x domain) — the numGroupsLimit-style memory valve.
+MAX_PRESENCE_CELLS = 1 << 26
+
+_DEFAULT_LOG2M = 12  # Pinot's DistinctCountHLL default log2m
+_DEFAULT_PERCENTILE_BINS = 2048
+
+
+@dataclass(frozen=True)
+class ColumnBinding:
+    """What the planner knows about the aggregated column at plan time.
+
+    kind is already alignment-resolved by planner.column_binding:
+      "dict"   - dictionary codes are a SHARED key space across all segments
+                 of the query (single segment, stacked table, or verified
+                 equal fingerprints) — code-indexed partials merge directly.
+      "rawint" - bounded int value range (table-global); partials index by
+                 (value - base), aligned by construction.
+      "raw"    - unbounded/float values; only hash-based sketches apply.
+    """
+
+    kind: str  # "dict" | "rawint" | "raw"
+    domain: int = 0  # dictionary cardinality / int range width
+    base: int = 0  # min value for rawint code normalization
+    # host-side dictionary values (numeric np array or object array) for
+    # hash precomputation; None for raw columns
+    dict_values: Optional[np.ndarray] = None
+    # column stats for histogram ranges
+    min_value: Any = None
+    max_value: Any = None
+
+
+# ---------------------------------------------------------------------------
+# Exact DISTINCTCOUNT
+# ---------------------------------------------------------------------------
+class DistinctCountFunction(AggFunction):
+    """Exact distinct count over a bounded code domain.
+
+    needs_codes: the planner feeds dictionary codes (or range-offset ints)
+    instead of values — the domain is what presence is tracked over."""
+
+    name = "distinctcount"
+    needs_codes = True
+    needs_binding = True
+    vector_fields = True
+    fields = ("present",)
+
+    # how the planner feeds rows: "codes" (shared-key-space dictionary) or
+    # "values_offset" (decoded value - base over a table-global int range)
+    input_kind = "codes"
+
+    def __init__(self, domain: int = 0, base: int = 0, input_kind: str = "codes"):
+        self.domain = domain
+        self.base = base
+        self.input_kind = input_kind
+
+    def bind_column(self, info: ColumnBinding) -> "DistinctCountFunction":
+        if info.kind == "dict":
+            # codes only merge across segments when the key space is shared —
+            # planner.column_binding already downgraded kind otherwise
+            return DistinctCountFunction(domain=info.domain, input_kind="codes")
+        if info.kind == "rawint":
+            return DistinctCountFunction(domain=info.domain, base=info.base, input_kind="values_offset")
+        raise NotImplementedError(
+            "exact DISTINCTCOUNT needs a shared dictionary or a bounded int "
+            "range; this column has neither (segments with differing "
+            "dictionaries, or unbounded/float values) — use DISTINCTCOUNTHLL"
+        )
+
+    # codes arrive as the "values" argument
+    def partial(self, codes, mask):
+        import jax.numpy as jnp
+
+        present = ops.group_count(mask, codes, self.domain) > 0
+        return {"present": present.astype(jnp.int32)}
+
+    def partial_grouped(self, codes, mask, keys, num_groups):
+        import jax.numpy as jnp
+
+        cells = num_groups * self.domain
+        if cells > MAX_PRESENCE_CELLS:
+            raise NotImplementedError(
+                f"DISTINCTCOUNT presence table {num_groups}x{self.domain} exceeds "
+                f"{MAX_PRESENCE_CELLS} cells; use DISTINCTCOUNTHLL"
+            )
+        flat = keys * np.int32(self.domain) + codes
+        present = ops.group_count(mask, flat, cells) > 0
+        return {"present": present.astype(jnp.int32).reshape(num_groups, self.domain)}
+
+    def merge(self, a, b):
+        return {"present": np.maximum(a["present"], b["present"])}
+
+    def final(self, p):
+        return np.asarray(p["present"]).sum(axis=-1)
+
+    def final_dtype(self):
+        return np.dtype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# DISTINCTCOUNTHLL
+# ---------------------------------------------------------------------------
+def _splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 over uint64 (host numpy — no per-value Python)."""
+    with np.errstate(over="ignore"):
+        z = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+
+def _hll_host_tables(values: np.ndarray, log2m: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-dictionary-id (bucket, rho) from a 64-bit host hash.
+
+    card hashes total — the dictionary trick: device rows only gather.
+    Numeric dictionaries hash fully vectorized; strings/bytes loop (their
+    bytes must be digested individually)."""
+    m = 1 << log2m
+    nbits = 64 - log2m
+    if values.dtype != object:
+        # bitcast numerics to uint64 (pad narrower types) + splitmix64
+        arr = np.asarray(values)
+        if arr.dtype.itemsize == 8:
+            u = arr.view(np.uint64)
+        else:
+            u = arr.astype(np.int64).view(np.uint64) if np.issubdtype(arr.dtype, np.integer) else arr.astype(np.float64).view(np.uint64)
+        h = _splitmix64_np(u.astype(np.uint64))
+        buckets = (h & np.uint64(m - 1)).astype(np.int32)
+        w = (h >> np.uint64(log2m)).astype(np.uint64)
+        # rho = nbits - floor(log2(w)) for w>0 else nbits+1, vectorized via
+        # float64 exponent (w < 2^52 after the shift, exact)
+        lg = np.zeros(len(w), dtype=np.int32)
+        nz = w > 0
+        lg[nz] = np.floor(np.log2(w[nz].astype(np.float64))).astype(np.int32)
+        rhos = np.where(nz, nbits - lg, nbits + 1).astype(np.int32)
+        return buckets, rhos
+    import hashlib
+
+    buckets = np.empty(len(values), dtype=np.int32)
+    rhos = np.empty(len(values), dtype=np.int32)
+    for i, v in enumerate(values):
+        b = v if isinstance(v, bytes) else str(v).encode("utf-8")
+        h = int.from_bytes(hashlib.blake2b(b, digest_size=8).digest(), "little")
+        buckets[i] = h & (m - 1)
+        w = h >> log2m
+        rhos[i] = (nbits - w.bit_length()) + 1 if w else nbits + 1
+    return buckets, rhos
+
+
+def _device_hash32(x):
+    """murmur3 finalizer on uint32 lanes (device-side, 32-bit ops only)."""
+    import jax.numpy as jnp
+
+    h = x.astype(jnp.uint32)
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def _device_hash_values(v):
+    """Hash arbitrary-width numeric values with 32-bit ops only.
+
+    8-byte types split into two 32-bit words so (nearly) the full bit
+    pattern participates — a plain int32 cast truncates and collides values
+    2^32 apart (review-caught).  TPU's X64 rewriter cannot lower 64-bit
+    bitcast-convert, so the split is arithmetic: LONGs shift/mask; DOUBLEs
+    take the float32 head + float32 residual (~48 mantissa bits; doubles
+    closer than that collide, which is within HLL's approximation budget)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if v.dtype.itemsize == 8:
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            head = v.astype(jnp.float32)
+            resid = (v - head.astype(jnp.float64)).astype(jnp.float32)
+            w0 = lax.bitcast_convert_type(head, jnp.uint32)
+            w1 = lax.bitcast_convert_type(resid, jnp.uint32)
+        else:
+            w0 = (v & np.int64(0xFFFFFFFF)).astype(jnp.uint32)
+            w1 = ((v >> np.int64(32)) & np.int64(0xFFFFFFFF)).astype(jnp.uint32)
+        return _device_hash32(w0 ^ _device_hash32(w1))
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        return _device_hash32(lax.bitcast_convert_type(v.astype(jnp.float32), jnp.uint32))
+    return _device_hash32(v.astype(jnp.int32))
+
+
+class DistinctCountHLLFunction(AggFunction):
+    """HyperLogLog distinct count: registers [.., m], combine = max."""
+
+    name = "distinctcounthll"
+    needs_codes = True
+    needs_binding = True
+    vector_fields = True
+    fields = ("hll",)
+
+    input_kind = "codes"
+
+    def __init__(self, log2m: int = _DEFAULT_LOG2M, bucket_table=None, rho_table=None, device_hash=False):
+        self.log2m = int(log2m)
+        self.m = 1 << self.log2m
+        self.bucket_table = bucket_table  # np.int32[card] for dict columns
+        self.rho_table = rho_table
+        self.device_hash = device_hash  # raw path: hash values on device
+        self.input_kind = "values_hash" if device_hash else "codes"
+
+    def with_args(self, literal_args):
+        if literal_args:
+            return DistinctCountHLLFunction(log2m=int(literal_args[0]))
+        return self
+
+    def bind_column(self, info: ColumnBinding) -> "DistinctCountHLLFunction":
+        if info.dict_values is not None:
+            # value-based host hash: registers align across segments even
+            # when dictionaries differ (HLL union is value-level), so this
+            # applies to "raw"-kind bindings of misaligned dict columns too
+            b, r = _hll_host_tables(info.dict_values, self.log2m)
+            return DistinctCountHLLFunction(self.log2m, bucket_table=b, rho_table=r)
+        return DistinctCountHLLFunction(self.log2m, device_hash=True)
+
+    def _bucket_rho(self, values_or_codes):
+        import jax.numpy as jnp
+
+        if self.device_hash:
+            h = _device_hash_values(values_or_codes)
+            bucket = (h & np.uint32(self.m - 1)).astype(jnp.int32)
+            w = (h >> np.uint32(self.log2m)).astype(jnp.int32)
+            nbits = 32 - self.log2m
+            # floor(log2(w)) via f32 exponent — w < 2^21 is exact in f32
+            lg = jnp.floor(jnp.log2(jnp.maximum(w, 1).astype(jnp.float32))).astype(jnp.int32)
+            rho = jnp.where(w > 0, nbits - lg, nbits + 1)
+            return bucket, rho
+        bucket = jnp.asarray(self.bucket_table)[values_or_codes]
+        rho = jnp.asarray(self.rho_table)[values_or_codes]
+        return bucket, rho
+
+    def partial(self, codes, mask):
+        import jax.numpy as jnp
+
+        bucket, rho = self._bucket_rho(codes)
+        regs = ops.group_max(rho, mask, bucket, self.m)
+        # group_max yields -inf for empty buckets; registers are >= 0
+        return {"hll": jnp.maximum(regs, 0.0).astype(jnp.int32)}
+
+    def partial_grouped(self, codes, mask, keys, num_groups):
+        import jax.numpy as jnp
+
+        bucket, rho = self._bucket_rho(codes)
+        flat = keys * np.int32(self.m) + bucket
+        regs = ops.group_max(rho, mask, flat, num_groups * self.m)
+        return {"hll": jnp.maximum(regs, 0.0).astype(jnp.int32).reshape(num_groups, self.m)}
+
+    def merge(self, a, b):
+        return {"hll": np.maximum(a["hll"], b["hll"])}
+
+    def final(self, p):
+        regs = np.asarray(p["hll"], dtype=np.float64)
+        m = regs.shape[-1]
+        alpha = 0.7213 / (1 + 1.079 / m)
+        est = alpha * m * m / np.sum(np.exp2(-regs), axis=-1)
+        zeros = np.sum(regs == 0, axis=-1)
+        # small-range correction (linear counting)
+        with np.errstate(divide="ignore"):
+            lc = m * np.log(np.where(zeros > 0, m / np.maximum(zeros, 1), 1.0))
+        est = np.where((est <= 2.5 * m) & (zeros > 0), lc, est)
+        return np.rint(est).astype(np.int64)
+
+    def final_dtype(self):
+        return np.dtype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# PERCENTILE (histogram sketch)
+# ---------------------------------------------------------------------------
+class PercentileFunction(AggFunction):
+    """Equi-width histogram percentile: partial = ("hist" add, "lo" min,
+    "hi" max).  The engine injects a table-global [lo, hi] via bind_column so
+    all segments share bin edges (mergeable by addition)."""
+
+    name = "percentile"
+    needs_binding = True
+    vector_fields = True
+    fields = ("hist", "lo", "hi")
+
+    def __init__(self, rank: float = 50.0, lo: float = 0.0, hi: float = 1.0, bins: int = _DEFAULT_PERCENTILE_BINS):
+        self.rank = float(rank)
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.bins = int(bins)
+
+    def with_args(self, literal_args):
+        if literal_args:
+            return PercentileFunction(rank=float(literal_args[0]), lo=self.lo, hi=self.hi, bins=self.bins)
+        return self
+
+    def bind_column(self, info: ColumnBinding) -> "PercentileFunction":
+        lo = float(info.min_value) if info.min_value is not None else 0.0
+        hi = float(info.max_value) if info.max_value is not None else 1.0
+        if hi <= lo:
+            hi = lo + 1.0
+        return PercentileFunction(self.rank, lo, hi, self.bins)
+
+    def _bin(self, values):
+        import jax.numpy as jnp
+
+        v = values.astype(jnp.float32)
+        scale = np.float32(self.bins / (self.hi - self.lo))
+        b = jnp.floor((v - np.float32(self.lo)) * scale).astype(jnp.int32)
+        return jnp.clip(b, 0, self.bins - 1)
+
+    def _range_fields(self, template):
+        import jax.numpy as jnp
+
+        lo = jnp.full(template, self.lo, dtype=jnp.float32)
+        hi = jnp.full(template, self.hi, dtype=jnp.float32)
+        return lo, hi
+
+    def partial(self, values, mask):
+        b = self._bin(values)
+        hist = ops.group_count(mask, b, self.bins)
+        lo, hi = self._range_fields(())
+        return {"hist": hist, "lo": lo, "hi": hi}
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        b = self._bin(values)
+        flat = keys * np.int32(self.bins) + b
+        hist = ops.group_count(mask, flat, num_groups * self.bins).reshape(num_groups, self.bins)
+        lo, hi = self._range_fields((num_groups,))
+        return {"hist": hist, "lo": lo, "hi": hi}
+
+    def merge(self, a, b):
+        # bin edges are injected table-globally (engine _inject_sketch_info);
+        # summing histograms with mismatched edges would silently skew the
+        # percentile, so mismatch is an error, not a merge
+        if not (np.allclose(a["lo"], b["lo"]) and np.allclose(a["hi"], b["hi"])):
+            raise ValueError(
+                "percentile histograms have mismatched bin edges "
+                f"([{a['lo']}, {a['hi']}] vs [{b['lo']}, {b['hi']}]) — partials "
+                "were built without a shared table-global range"
+            )
+        return {
+            "hist": a["hist"] + b["hist"],
+            "lo": np.minimum(a["lo"], b["lo"]),
+            "hi": np.maximum(a["hi"], b["hi"]),
+        }
+
+    def final(self, p):
+        hist = np.atleast_2d(np.asarray(p["hist"], dtype=np.float64))
+        lo = np.atleast_1d(np.asarray(p["lo"], dtype=np.float64))
+        hi = np.atleast_1d(np.asarray(p["hi"], dtype=np.float64))
+        n_groups, bins = hist.shape
+        out = np.full(n_groups, np.nan)
+        width = (hi - lo) / bins
+        for g in range(n_groups):
+            total = hist[g].sum()
+            if total == 0:
+                continue
+            target = self.rank / 100.0 * total
+            cum = np.cumsum(hist[g])
+            idx = int(np.searchsorted(cum, target, side="left"))
+            idx = min(idx, bins - 1)
+            prev = cum[idx - 1] if idx > 0 else 0.0
+            in_bin = hist[g][idx]
+            frac = (target - prev) / in_bin if in_bin > 0 else 0.0
+            out[g] = lo[g] + width[g] * (idx + frac)
+        scalar = np.asarray(p["hist"]).ndim == 1
+        return out[0] if scalar else out
+
+
+# The Est/TDigest/KLL names resolve to the same mergeable histogram sketch;
+# accuracy contract is (hi-lo)/bins instead of the reference's per-sketch
+# bounds (documented delta — the partials remain mergeable across segments
+# and psum-combinable across chips, which the reference's sketches are not).
+class PercentileEstFunction(PercentileFunction):
+    name = "percentileest"
+
+
+class PercentileTDigestFunction(PercentileFunction):
+    name = "percentiletdigest"
+
+
+class PercentileKLLFunction(PercentileFunction):
+    name = "percentilekll"
+
+
+for _cls in (
+    DistinctCountFunction,
+    DistinctCountHLLFunction,
+    PercentileFunction,
+    PercentileEstFunction,
+    PercentileTDigestFunction,
+    PercentileKLLFunction,
+):
+    register(_cls())
+
+# Pinot alias: exact distinct count over partitioned segments
+from pinot_tpu.query.functions import _REGISTRY  # noqa: E402
+
+_REGISTRY["segmentpartitioneddistinctcount"] = _REGISTRY["distinctcount"]
+_REGISTRY["distinctcountbitmap"] = _REGISTRY["distinctcount"]

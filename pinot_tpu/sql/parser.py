@@ -277,9 +277,7 @@ class _Parser:
     # Aggregation names the engine knows about but has not implemented yet —
     # parsed specially so the user sees "unsupported aggregation" instead of
     # a misleading selection-expression error.
-    _KNOWN_UNIMPLEMENTED_AGGS = frozenset(
-        {"distinctcount", "distinctcounthll", "distinctcountrawhll", "percentile", "percentileest", "percentiletdigest", "percentilekll"}
-    )
+    _KNOWN_UNIMPLEMENTED_AGGS = frozenset({"distinctcountrawhll", "distinctcountthetasketch"})
 
     def expr_or_agg(self) -> Union[Expr, AggregationSpec]:
         """Expression that may be a top-level aggregation call."""
@@ -509,7 +507,9 @@ class _Parser:
                         self.expect_op(")")
                         if str(name).lower() == "count":
                             return Expr.call("distinctcount", arg)
-                        return Expr.call(name, arg)
+                        # silently dropping DISTINCT would return wrong
+                        # results (SUM(DISTINCT x) != SUM(x))
+                        self.fail(f"{name}(DISTINCT ...) is not supported")
                     args.append(self.expr())
                     while self.accept_op(","):
                         args.append(self.expr())

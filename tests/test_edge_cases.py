@@ -50,9 +50,14 @@ def test_literal_divisor_and_cast(eng):
     assert r.rows[0][1] == pytest.approx(107.0)
 
 
-def test_count_distinct_clear_error():
+def test_count_distinct_parses_to_distinctcount():
+    ctx = parse_query("SELECT COUNT(DISTINCT g) FROM t")
+    assert ctx.select_list[0].function == "distinctcount"
+
+
+def test_unimplemented_agg_clear_error():
     with pytest.raises(SqlParseError, match="not supported yet"):
-        parse_query("SELECT COUNT(DISTINCT g) FROM t")
+        parse_query("SELECT DISTINCTCOUNTRAWHLL(g) FROM t")
 
 
 def test_sum_of_pure_literal(eng):

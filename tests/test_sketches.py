@@ -1,0 +1,186 @@
+"""M3 tests: DISTINCTCOUNT (exact), DISTINCTCOUNTHLL, PERCENTILE sketches —
+scalar + grouped, in-process + distributed, golden-checked where exact."""
+import numpy as np
+import pytest
+
+from pinot_tpu.parallel.engine import DistributedEngine
+from pinot_tpu.parallel.stacked import StackedTable
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+from golden import assert_same_rows, sqlite_from_data
+
+N = 8000
+
+
+def _schema():
+    return Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("user_id", DataType.INT),
+            FieldSpec("latency", DataType.DOUBLE, role=FieldRole.METRIC),
+        ],
+    )
+
+
+def _data(rng):
+    return {
+        "city": rng.choice(["sf", "nyc", "chi", "la"], N).astype(object),
+        "user_id": rng.integers(0, 900, N).astype(np.int32),
+        "latency": np.round(rng.exponential(50, N), 3),
+    }
+
+
+@pytest.fixture(scope="module")
+def env():
+    rng = np.random.default_rng(3)
+    schema = _schema()
+    eng = QueryEngine()
+    eng.register_table(schema)
+    datas = [_data(rng), _data(rng)]
+    for i, d in enumerate(datas):
+        eng.add_segment("t", build_segment(schema, d, f"s{i}"))
+    merged = {k: np.concatenate([d[k] for d in datas]) for k in datas[0]}
+    conn = sqlite_from_data("t", merged)
+    return eng, conn, merged
+
+
+def test_distinctcount_exact_scalar(env):
+    eng, conn, _ = env
+    got = eng.query("SELECT DISTINCTCOUNT(user_id), DISTINCTCOUNT(city) FROM t")
+    exp = conn.execute("SELECT COUNT(DISTINCT user_id), COUNT(DISTINCT city) FROM t").fetchall()
+    assert_same_rows(got.rows, exp)
+
+
+def test_count_distinct_sugar(env):
+    eng, conn, _ = env
+    got = eng.query("SELECT COUNT(DISTINCT user_id) FROM t WHERE city = 'sf'")
+    exp = conn.execute("SELECT COUNT(DISTINCT user_id) FROM t WHERE city = 'sf'").fetchall()
+    assert_same_rows(got.rows, exp)
+
+
+def test_distinctcount_grouped(env):
+    eng, conn, _ = env
+    got = eng.query("SELECT city, DISTINCTCOUNT(user_id) FROM t GROUP BY city ORDER BY city LIMIT 10")
+    exp = conn.execute(
+        "SELECT city, COUNT(DISTINCT user_id) FROM t GROUP BY city ORDER BY city LIMIT 10"
+    ).fetchall()
+    assert_same_rows(got.rows, exp, ordered=True)
+
+
+def test_hll_accuracy(env):
+    eng, conn, _ = env
+    got = eng.query("SELECT DISTINCTCOUNTHLL(user_id) FROM t").rows[0][0]
+    exact = conn.execute("SELECT COUNT(DISTINCT user_id) FROM t").fetchone()[0]
+    assert abs(got - exact) / exact < 0.05, (got, exact)
+
+
+def test_hll_grouped_and_string(env):
+    eng, conn, _ = env
+    rows = eng.query(
+        "SELECT city, DISTINCTCOUNTHLL(user_id) FROM t GROUP BY city ORDER BY city LIMIT 10"
+    ).rows
+    exp = dict(
+        conn.execute("SELECT city, COUNT(DISTINCT user_id) FROM t GROUP BY city").fetchall()
+    )
+    for city, est in rows:
+        assert abs(est - exp[city]) / exp[city] < 0.07, (city, est, exp[city])
+
+
+def test_percentile_scalar(env):
+    eng, _, merged = env
+    for rank in (50, 90, 99):
+        got = eng.query(f"SELECT PERCENTILE(latency, {rank}) FROM t").rows[0][0]
+        exact = np.percentile(merged["latency"], rank)
+        binw = (merged["latency"].max() - merged["latency"].min()) / 2048
+        assert abs(got - exact) <= max(2 * binw, 0.05 * exact), (rank, got, exact)
+
+
+def test_percentile_grouped_multisegment(env):
+    """Bin edges must align across segments (engine-injected global range)."""
+    eng, _, merged = env
+    rows = eng.query(
+        "SELECT city, PERCENTILETDIGEST(latency, 90) FROM t GROUP BY city ORDER BY city LIMIT 10"
+    ).rows
+    binw = (merged["latency"].max() - merged["latency"].min()) / 2048
+    for city, est in rows:
+        sel = merged["latency"][merged["city"] == city]
+        exact = np.percentile(sel, 90)
+        assert abs(est - exact) <= max(3 * binw, 0.05 * exact), (city, est, exact)
+
+
+def test_sketches_distributed(env):
+    _, conn, merged = env
+    st = StackedTable.build(_schema(), merged, 8)
+    deng = DistributedEngine()
+    deng.register_table("t", st)
+    got = deng.query("SELECT city, DISTINCTCOUNT(user_id) FROM t GROUP BY city ORDER BY city LIMIT 10")
+    exp = conn.execute(
+        "SELECT city, COUNT(DISTINCT user_id) FROM t GROUP BY city ORDER BY city LIMIT 10"
+    ).fetchall()
+    assert_same_rows(got.rows, exp, ordered=True)
+    est = deng.query("SELECT DISTINCTCOUNTHLL(user_id) FROM t").rows[0][0]
+    exact = conn.execute("SELECT COUNT(DISTINCT user_id) FROM t").fetchone()[0]
+    assert abs(est - exact) / exact < 0.05
+    p90 = deng.query("SELECT PERCENTILE(latency, 90) FROM t").rows[0][0]
+    exact90 = np.percentile(merged["latency"], 90)
+    assert abs(p90 - exact90) <= 0.05 * exact90
+
+
+def test_distinctcount_having(env):
+    eng, conn, _ = env
+    got = eng.query(
+        "SELECT city, DISTINCTCOUNT(user_id) FROM t GROUP BY city "
+        "HAVING DISTINCTCOUNT(user_id) > 0 ORDER BY city LIMIT 10"
+    )
+    exp = conn.execute(
+        "SELECT city, COUNT(DISTINCT user_id) FROM t GROUP BY city ORDER BY city LIMIT 10"
+    ).fetchall()
+    assert_same_rows(got.rows, exp, ordered=True)
+
+
+# ---------------------------------------------------------------------------
+# Cross-segment alignment regressions (review findings)
+# ---------------------------------------------------------------------------
+def test_distinctcount_heterogeneous_string_dicts_error():
+    """Misaligned string dictionaries must error, not silently mis-merge."""
+    schema = Schema("h1", [FieldSpec("s", DataType.STRING)])
+    e = QueryEngine()
+    e.register_table(schema)
+    e.add_segment("h1", build_segment(schema, {"s": np.array(["a", "b", "c"], dtype=object)}, "s0"))
+    e.add_segment("h1", build_segment(schema, {"s": np.array(["b", "c", "d"], dtype=object)}, "s1"))
+    with pytest.raises(NotImplementedError, match="shared dictionary"):
+        e.query("SELECT DISTINCTCOUNT(s) FROM h1")
+    # HLL is value-based: correct across misaligned dictionaries
+    assert e.query("SELECT DISTINCTCOUNTHLL(s) FROM h1").rows[0][0] == 4
+
+
+def test_distinctcount_heterogeneous_int_dicts_exact():
+    """Numeric columns downgrade to a table-global value range: still exact."""
+    schema = Schema("h2", [FieldSpec("x", DataType.INT)])
+    e = QueryEngine()
+    e.register_table(schema)
+    e.add_segment("h2", build_segment(schema, {"x": np.array([1, 2, 3], dtype=np.int32)}, "s0"))
+    e.add_segment("h2", build_segment(schema, {"x": np.array([2, 3, 9], dtype=np.int32)}, "s1"))
+    assert e.query("SELECT DISTINCTCOUNT(x) FROM h2").rows[0][0] == 4
+
+
+def test_hll_raw_double_no_truncation():
+    """HLL on a raw DOUBLE column hashes the value bits, not int32(v)."""
+    schema = Schema("h3", [FieldSpec("d", DataType.DOUBLE, role=FieldRole.METRIC)])
+    vals = np.random.default_rng(0).random(20000) * 100  # int32 cast would give ~100
+    e = QueryEngine()
+    e.register_table(schema)
+    e.add_segment("h3", build_segment(schema, {"d": vals}, "s0"))
+    est = e.query("SELECT DISTINCTCOUNTHLL(d) FROM h3").rows[0][0]
+    exact = len(np.unique(vals))
+    assert abs(est - exact) / exact < 0.06
+
+
+def test_sum_distinct_rejected():
+    from pinot_tpu.sql.parser import SqlParseError, parse_query
+
+    with pytest.raises(SqlParseError, match="DISTINCT"):
+        parse_query("SELECT SUM(DISTINCT x) FROM t")

@@ -257,11 +257,24 @@ def masked_count(mask):
 def masked_sum(values, mask):
     """f64 scalar masked sum.
 
-    chunked32: XLA's tree reduction in f32 keeps relative error ~2^-24 *
-    log2(n); exact-integer upgrades ride the group path when needed."""
+    chunked32: integer inputs (int32 and narrower) ride the exact limb path
+    as a 1-group group_sum — bit-exact like the grouped path, matching
+    Pinot's double accumulator below 2^53.  Floats use XLA's f32 tree
+    reduction with an f64 chunk combine (~2^-24 relative error per chunk)."""
     if accum_policy() == "wide":
         return jnp.sum(jnp.where(mask, values.astype(jnp.float64), 0.0))
-    n = values.shape[0]
+    if jnp.issubdtype(values.dtype, jnp.integer) and values.dtype.itemsize <= 4:
+        # direct chunked limb reduction (no one-hot needed without groups):
+        # per-chunk per-limb f32 sums <= 255 * _CHUNK < 2^24 are exact.
+        vm = jnp.where(mask, values, np.int32(0)).astype(jnp.int32)
+        u = vm.astype(jnp.uint32)
+        limbs = [((u >> np.uint32(8 * i)) & np.uint32(0xFF)).astype(jnp.float32) for i in range(4)]
+        limbs.append((vm < 0).astype(jnp.float32))  # two's-complement correction
+        scales = [float(1 << (8 * i)) for i in range(4)] + [-float(1 << 32)]
+        stacked = jnp.stack(limbs, axis=1)
+        (stacked,) = _pad_to_chunks(stacked)
+        chunk_sums = stacked.reshape(-1, _CHUNK, len(limbs)).sum(axis=1)
+        return (chunk_sums.astype(jnp.float64) * jnp.asarray(scales, jnp.float64)).sum()
     v = jnp.where(mask, values.astype(jnp.float32), np.float32(0.0))
     # two-stage: f32 chunk sums (vectorized reduce), f64 combine of the
     # small vector — bounds error without the scatter.

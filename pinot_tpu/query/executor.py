@@ -105,6 +105,7 @@ def execute_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
 
     if plan.kind == "aggregation":
         partials = jax.device_get(plan.fn(cols, params))
+        partials = [fn.host_partial(p) for fn, p in zip(plan.aggs, partials)]
         return AggSegmentResult(partials=partials), stats
 
     if plan.kind == "groupby_dense":

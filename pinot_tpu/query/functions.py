@@ -92,6 +92,12 @@ class AggFunction:
     def partial_grouped(self, values, mask, keys, num_groups: int) -> Partial:
         raise NotImplementedError
 
+    # -- host: post-device_get conversion hook ---------------------------
+    def host_partial(self, p: Partial) -> Partial:
+        """Convert a device partial to its host merge form (identity for
+        tensor partials; value-set sketches decode here)."""
+        return p
+
     # -- host or device: combine ----------------------------------------
     def merge(self, a: Partial, b: Partial) -> Partial:
         raise NotImplementedError

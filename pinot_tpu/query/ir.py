@@ -233,10 +233,16 @@ class QueryContext:
     # SQL `SET key=value` per-query options (QueryOptionsUtils analog):
     # numGroupsLimit, enableNullHandling, timeoutMs, maxExecutionThreads...
     options: Dict[str, Any] = dc_field(default_factory=dict)
+    # aggregations referenced ONLY by ORDER BY/HAVING (not selected) — Pinot
+    # allows `GROUP BY d ORDER BY SUM(v)` without selecting SUM(v); these are
+    # computed alongside select aggregations but excluded from output rows.
+    extra_aggregations: List[AggregationSpec] = dc_field(default_factory=list)
 
     @property
     def aggregations(self) -> List[AggregationSpec]:
-        return [s for s in self.select_list if isinstance(s, AggregationSpec)]
+        return [s for s in self.select_list if isinstance(s, AggregationSpec)] + list(
+            self.extra_aggregations
+        )
 
     @property
     def is_aggregate(self) -> bool:
@@ -276,6 +282,7 @@ class QueryContext:
             "|".join(g.fingerprint() for g in self.group_by),
             self.having.fingerprint() if self.having else "",
             "|".join(f"{o.expr.fingerprint()}:{o.ascending}" for o in self.order_by),
+            "|".join(a.fingerprint() for a in self.extra_aggregations),
             str(self.limit),
             str(self.offset),
             str(sorted(self.options.items())),

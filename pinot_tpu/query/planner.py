@@ -175,7 +175,7 @@ def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
         cols.extend(ctx.filter.columns())
     for g in ctx.group_by:
         cols.extend(g.columns())
-    for s in ctx.select_list:
+    for s in list(ctx.select_list) + list(ctx.extra_aggregations):
         if isinstance(s, AggregationSpec):
             if s.expr is not None:
                 cols.extend(s.expr.columns())
@@ -183,10 +183,22 @@ def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
                 cols.extend(s.filter.columns())
         else:
             cols.extend(s.columns())
+    # ORDER BY/HAVING references to AGGREGATION aliases are resolved by
+    # reduce against final arrays, not segment columns — skip them unless a
+    # physical column shadows the alias.
+    agg_aliases = {
+        a
+        for s, a in zip(ctx.select_list, ctx.select_aliases)
+        if a and isinstance(s, AggregationSpec)
+    }
+    physical = set(segment.schema.column_names)
+    alias_only = agg_aliases - physical
+    # "*" here can only come from count(*) inside an ORDER BY/HAVING call —
+    # it needs no column loads (unlike SELECT *).
     for o in ctx.order_by:
-        cols.extend(o.expr.columns())
+        cols.extend(c for c in o.expr.columns() if c not in alias_only and c != "*")
     if ctx.having:
-        cols.extend(ctx.having.columns())
+        cols.extend(c for c in ctx.having.columns() if c not in alias_only and c != "*")
     seen, out = set(), []
     for c in cols:
         if c == "*":

@@ -61,12 +61,13 @@ def _reduce_aggregation(ctx: QueryContext, results: List[AggSegmentResult], stat
         else:
             merged = [fn.merge(m, p) for fn, m, p in zip(aggs, merged, r.partials)]
     row = []
+    n_selected = len(ctx.select_list)  # extras (ORDER BY/HAVING-only) don't output
     if merged is None:
         # all segments pruned: COUNT=0, others NULL
-        for fn in aggs:
+        for fn in aggs[:n_selected]:
             row.append(0 if fn.name == "count" else None)
     else:
-        for fn, p in zip(aggs, merged):
+        for fn, p in zip(aggs[:n_selected], merged[:n_selected]):
             row.append(_scalar(fn.final(p)))
     return ResultTable(columns=ctx.column_names_out(), rows=[tuple(row)], stats=stats)
 
@@ -118,13 +119,21 @@ def _reduce_groupby(ctx: QueryContext, results: List[GroupBySegmentResult], stat
         env[g.fingerprint()] = k
     for spec, f in zip(ctx.aggregations, finals):
         env[spec.fingerprint()] = f
-        # HAVING/ORDER BY reference aggregations as plain calls: sum(v)
-        if spec.filter is None and not spec.literal_args:
-            call = Expr.call(spec.function, *([spec.expr] if spec.expr else []))
-            env.setdefault(call.fingerprint(), f)
-            if spec.expr is None:
+        # HAVING/ORDER BY reference aggregations as plain calls: sum(v),
+        # percentile(v, 95) — literal args re-attach as literal exprs.
+        if spec.filter is None:
+            args = list(spec.expr and [spec.expr] or []) + [Expr.lit(a) for a in spec.literal_args]
+            env.setdefault(Expr.call(spec.function, *args).fingerprint(), f)
+            if spec.expr is None and not spec.literal_args:
                 # `count(*)` written explicitly (parser form)
                 env.setdefault(Expr.call(spec.function, Expr.col("*")).fingerprint(), f)
+    # select aliases: ORDER BY/HAVING may reference any select item by alias
+    # (covers filtered/literal-arg aggregations the call forms above can't)
+    for s, alias in zip(ctx.select_list, ctx.select_aliases):
+        if alias:
+            fp = s.fingerprint()
+            if fp in env:
+                env.setdefault(Expr.col(alias).fingerprint(), env[fp])
 
     # HAVING
     n = len(keys[0]) if keys else 0

@@ -46,12 +46,30 @@ import numpy as np
 from pinot_tpu import ops
 from pinot_tpu.query.functions import AggFunction, register
 
-# Exact distinct-count presence tables are capped at this many cells
-# (groups x domain) — the numGroupsLimit-style memory valve.
+# Grouped sketch tables (presence bitmaps, HLL registers, histograms) are
+# capped at this many cells (groups x per-group width) — the
+# numGroupsLimit-style memory valve.  Also guarantees the flattened
+# keys*width+offset index stays far below int32 overflow (silent
+# FILL_OR_DROP row loss otherwise).
 MAX_PRESENCE_CELLS = 1 << 26
 
-_DEFAULT_LOG2M = 12  # Pinot's DistinctCountHLL default log2m
+# Pinot's DistinctCountHLL default is log2m=8 for the plain HLL type
+# (CommonConstants.Helix.DEFAULT_HYPERLOGLOG_LOG2M); we default to 12 —
+# ~0.8% standard error vs ~6.5% — because the register table is a cheap
+# device tensor here.  Documented accuracy delta; pass an explicit log2m
+# literal arg for parity.
+_DEFAULT_LOG2M = 12
 _DEFAULT_PERCENTILE_BINS = 2048
+
+
+def _check_cell_budget(fn_name: str, num_groups: int, width: int) -> None:
+    cells = num_groups * width
+    if cells > MAX_PRESENCE_CELLS:
+        raise NotImplementedError(
+            f"{fn_name} grouped table {num_groups}x{width} = {cells} cells exceeds "
+            f"{MAX_PRESENCE_CELLS}; lower group-key cardinality, numGroupsLimit, "
+            "or the sketch width (log2m / bins)"
+        )
 
 
 @dataclass(frozen=True)
@@ -102,17 +120,23 @@ class DistinctCountFunction(AggFunction):
         self.base = base
         self.input_kind = input_kind
 
-    def bind_column(self, info: ColumnBinding) -> "DistinctCountFunction":
+    def bind_column(self, info: ColumnBinding) -> "AggFunction":
         if info.kind == "dict":
             # codes only merge across segments when the key space is shared —
             # planner.column_binding already downgraded kind otherwise
             return DistinctCountFunction(domain=info.domain, input_kind="codes")
         if info.kind == "rawint":
             return DistinctCountFunction(domain=info.domain, base=info.base, input_kind="values_offset")
+        if info.dict_values is not None:
+            # misaligned per-segment dictionaries: exact count still works by
+            # unioning DECODED value sets at reduce (the reference's
+            # DistinctCountAggregationFunction value-set semantics); device
+            # work stays a local presence bitmap, host decodes present codes
+            return DistinctCountValueSetFunction(info.dict_values)
         raise NotImplementedError(
-            "exact DISTINCTCOUNT needs a shared dictionary or a bounded int "
-            "range; this column has neither (segments with differing "
-            "dictionaries, or unbounded/float values) — use DISTINCTCOUNTHLL"
+            "exact DISTINCTCOUNT needs a dictionary or a bounded int range; "
+            "this column has neither (unbounded/float raw values) — use "
+            "DISTINCTCOUNTHLL"
         )
 
     # codes arrive as the "values" argument
@@ -125,21 +149,69 @@ class DistinctCountFunction(AggFunction):
     def partial_grouped(self, codes, mask, keys, num_groups):
         import jax.numpy as jnp
 
+        _check_cell_budget(self.name, num_groups, self.domain)
         cells = num_groups * self.domain
-        if cells > MAX_PRESENCE_CELLS:
-            raise NotImplementedError(
-                f"DISTINCTCOUNT presence table {num_groups}x{self.domain} exceeds "
-                f"{MAX_PRESENCE_CELLS} cells; use DISTINCTCOUNTHLL"
-            )
         flat = keys * np.int32(self.domain) + codes
         present = ops.group_count(mask, flat, cells) > 0
         return {"present": present.astype(jnp.int32).reshape(num_groups, self.domain)}
 
     def merge(self, a, b):
+        # the unbound registry singleton merges BOTH partial forms: presence
+        # bitmaps (aligned code spaces) and host value sets (fallback below)
+        if "valueset" in a:
+            return {"valueset": a["valueset"] | b["valueset"]}
         return {"present": np.maximum(a["present"], b["present"])}
 
     def final(self, p):
+        if "valueset" in p:
+            return len(p["valueset"])
         return np.asarray(p["present"]).sum(axis=-1)
+
+    def final_dtype(self):
+        return np.dtype(np.int64)
+
+
+class DistinctCountValueSetFunction(AggFunction):
+    """Exact distinct count across segments with DIFFERENT dictionaries.
+
+    Device partial: presence bitmap over the segment's LOCAL dictionary.
+    host_partial decodes present codes into a frozenset; reduce unions sets
+    (reference DistinctCountAggregationFunction's value-set merge).  Grouped
+    form is unsupported (per-group sets defeat the tensor contract) — use
+    DISTINCTCOUNTHLL for grouped heterogeneous-dictionary counts."""
+
+    name = "distinctcount"
+    needs_codes = True
+    needs_binding = True
+    vector_fields = True
+    fields = ("present",)
+    input_kind = "codes"
+
+    def __init__(self, dict_values):
+        self._values = np.asarray(dict_values, dtype=object)
+        self.domain = len(self._values)
+
+    def partial(self, codes, mask):
+        import jax.numpy as jnp
+
+        present = ops.group_count(mask, codes, self.domain) > 0
+        return {"present": present.astype(jnp.int32)}
+
+    def partial_grouped(self, codes, mask, keys, num_groups):
+        raise NotImplementedError(
+            "exact grouped DISTINCTCOUNT requires a shared dictionary across "
+            "segments; these segments' dictionaries differ — use DISTINCTCOUNTHLL"
+        )
+
+    def host_partial(self, p):
+        present = np.asarray(p["present"]) > 0
+        return {"valueset": frozenset(self._values[present].tolist())}
+
+    def merge(self, a, b):
+        return {"valueset": a["valueset"] | b["valueset"]}
+
+    def final(self, p):
+        return len(p["valueset"])
 
     def final_dtype(self):
         return np.dtype(np.int64)
@@ -295,6 +367,7 @@ class DistinctCountHLLFunction(AggFunction):
     def partial_grouped(self, codes, mask, keys, num_groups):
         import jax.numpy as jnp
 
+        _check_cell_budget(self.name, num_groups, self.m)
         bucket, rho = self._bucket_rho(codes)
         flat = keys * np.int32(self.m) + bucket
         regs = ops.group_max(rho, mask, flat, num_groups * self.m)
@@ -372,6 +445,7 @@ class PercentileFunction(AggFunction):
         return {"hist": hist, "lo": lo, "hi": hi}
 
     def partial_grouped(self, values, mask, keys, num_groups):
+        _check_cell_budget(self.name, num_groups, self.bins)
         b = self._bin(values)
         flat = keys * np.int32(self.bins) + b
         hist = ops.group_count(mask, flat, num_groups * self.bins).reshape(num_groups, self.bins)

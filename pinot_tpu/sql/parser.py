@@ -14,19 +14,53 @@ exactly like Pinot's predicate contexts).
 """
 from __future__ import annotations
 
+import dataclasses
 import re
-from typing import Any, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 from pinot_tpu.query.functions import is_agg_function
 from pinot_tpu.query.ir import (
     AggregationSpec,
     Expr,
+    ExprKind,
     FilterNode,
+    FilterOp,
     OrderByExpr,
     Predicate,
     PredicateType,
     QueryContext,
 )
+
+
+def _substitute_alias_expr(e: Expr, mapping: Dict[str, Expr]) -> Expr:
+    """Replace bare-column references to select aliases with the aliased
+    expression (Calcite resolves ORDER BY/HAVING aliases the same way).
+
+    Does NOT descend into aggregation calls: columns inside SUM(v) resolve
+    against the table even when an alias shadows the name (MySQL/Calcite
+    resolution — otherwise `SELECT year AS v, SUM(v) ... HAVING SUM(v)>k`
+    silently becomes SUM(year))."""
+    if e.is_column and e.op in mapping:
+        return mapping[e.op]
+    if e.kind is ExprKind.CALL and not is_agg_function(e.op):
+        new_args = tuple(_substitute_alias_expr(a, mapping) for a in e.args)
+        if new_args != e.args:
+            return Expr(ExprKind.CALL, op=e.op, value=e.value, args=new_args)
+    return e
+
+
+def _substitute_alias_filter(node: FilterNode, mapping: Dict[str, Expr]) -> FilterNode:
+    if node.op is FilterOp.PRED:
+        p = node.predicate
+        new_lhs = _substitute_alias_expr(p.lhs, mapping)
+        if new_lhs is not p.lhs:
+            return FilterNode.pred(dataclasses.replace(p, lhs=new_lhs))
+        return node
+    return FilterNode(
+        node.op,
+        children=tuple(_substitute_alias_filter(c, mapping) for c in node.children),
+        predicate=node.predicate,
+    )
 
 
 class SqlParseError(ValueError):
@@ -203,7 +237,10 @@ class _Parser:
         if self.accept_kw("order"):
             self.expect_kw("by")
             while True:
-                e = self.expr_or_agg()
+                # Plain expression parse: an aggregation call like SUM(v)
+                # stays an Expr.call — reduce resolves its fingerprint against
+                # the aggregation results (env.setdefault in _reduce_groupby).
+                e = self.expr()
                 asc = True
                 if self.accept_kw("desc"):
                     asc = False
@@ -241,6 +278,26 @@ class _Parser:
                     break
             self.expect_op(")")
 
+        # Resolve select aliases referenced in ORDER BY / HAVING.  Plain
+        # expressions substitute in-place (so `SELECT ts AS t ... ORDER BY t`
+        # plans on the real column); aggregation aliases stay as bare columns
+        # — reduce registers alias -> final array in its env, and the planner
+        # skips them in _needed_columns.  Alias wins over a same-named
+        # physical column only when the physical column doesn't exist
+        # (checked planner-side; here substitution is unconditional for
+        # expression aliases, matching MySQL/Calcite alias-first resolution).
+        expr_aliases: Dict[str, Expr] = {}
+        for item, alias in zip(select_list, aliases):
+            if alias and isinstance(item, Expr) and not (item.is_column and item.op == alias):
+                expr_aliases[alias] = item
+        if expr_aliases:
+            order_by = [
+                OrderByExpr(_substitute_alias_expr(o.expr, expr_aliases), o.ascending, o.nulls_last)
+                for o in order_by
+            ]
+            if having is not None:
+                having = _substitute_alias_filter(having, expr_aliases)
+
         if distinct:
             # DISTINCT c1, c2 == GROUP BY c1, c2 selecting keys only (Pinot
             # executes DISTINCT via DistinctOperator; group-by is equivalent).
@@ -248,6 +305,34 @@ class _Parser:
                 self.fail("SELECT DISTINCT with aggregations is not supported")
             group_by = [s for s in select_list if isinstance(s, Expr)]
             # DISTINCT defaults to LIMIT 10 like Pinot
+
+        # Aggregations referenced by ORDER BY/HAVING but not selected are
+        # computed as hidden extras (Pinot permits ORDER BY SUM(v) without
+        # selecting it).  Top-level calls only; post-aggregation arithmetic
+        # over aggs stays unsupported here.
+        extra_aggs: List[AggregationSpec] = []
+        if group_by:
+            selected_fps = {
+                s.fingerprint() for s in select_list if isinstance(s, AggregationSpec)
+            }
+
+            def _maybe_extra(e: Expr) -> None:
+                if (
+                    isinstance(e, Expr)
+                    and e.kind is ExprKind.CALL
+                    and is_agg_function(e.op)
+                ):
+                    spec = self._call_to_agg(e)
+                    if spec.fingerprint() not in selected_fps and not any(
+                        spec.fingerprint() == x.fingerprint() for x in extra_aggs
+                    ):
+                        extra_aggs.append(spec)
+
+            for o in order_by:
+                _maybe_extra(o.expr)
+            if having is not None:
+                for pred in having.predicates():
+                    _maybe_extra(pred.lhs)
 
         return QueryContext(
             table=table,
@@ -260,6 +345,7 @@ class _Parser:
             limit=limit,
             offset=offset,
             options=options,
+            extra_aggregations=extra_aggs,
         )
 
     # -- select items ----------------------------------------------------

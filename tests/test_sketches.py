@@ -144,15 +144,18 @@ def test_distinctcount_having(env):
 # ---------------------------------------------------------------------------
 # Cross-segment alignment regressions (review findings)
 # ---------------------------------------------------------------------------
-def test_distinctcount_heterogeneous_string_dicts_error():
-    """Misaligned string dictionaries must error, not silently mis-merge."""
+def test_distinctcount_heterogeneous_string_dicts_exact():
+    """Misaligned string dictionaries fall back to host value-set union
+    (reference DistinctCountAggregationFunction semantics) — still exact."""
     schema = Schema("h1", [FieldSpec("s", DataType.STRING)])
     e = QueryEngine()
     e.register_table(schema)
     e.add_segment("h1", build_segment(schema, {"s": np.array(["a", "b", "c"], dtype=object)}, "s0"))
     e.add_segment("h1", build_segment(schema, {"s": np.array(["b", "c", "d"], dtype=object)}, "s1"))
-    with pytest.raises(NotImplementedError, match="shared dictionary"):
-        e.query("SELECT DISTINCTCOUNT(s) FROM h1")
+    assert e.query("SELECT DISTINCTCOUNT(s) FROM h1").rows[0][0] == 4
+    # grouped heterogeneous stays unsupported (per-group sets defeat tensors)
+    with pytest.raises(NotImplementedError, match="DISTINCTCOUNTHLL"):
+        e.query("SELECT s, DISTINCTCOUNT(s) FROM h1 GROUP BY s")
     # HLL is value-based: correct across misaligned dictionaries
     assert e.query("SELECT DISTINCTCOUNTHLL(s) FROM h1").rows[0][0] == 4
 

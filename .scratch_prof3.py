@@ -1,0 +1,32 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+N = 1 << 27
+rng = np.random.default_rng(0)
+v = rng.integers(100, 1_000_000, N).astype(np.int32)
+d_v = jax.device_put(v)
+print("devices:", jax.devices(), "committed:", d_v.committed, d_v.sharding)
+
+@jax.jit
+def sum1(x):
+    return x.astype(jnp.float32).sum()
+
+@jax.jit
+def sum10(x):
+    def body(i, acc):
+        return acc + (x + i).astype(jnp.float32).sum()
+    return lax.fori_loop(0, 10, body, jnp.float32(0))
+
+def bench(fn, *args, reps=5):
+    out = fn(*args); jax.device_get(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = fn(*args); jax.device_get(out); ts.append(time.perf_counter()-t0)
+    return float(np.median(ts))
+
+t1 = bench(sum1, d_v)
+t10 = bench(sum10, d_v)
+print(f"sum x1: {t1*1000:.1f}ms -> {4*N/t1/1e9:.1f} GB/s")
+print(f"sum x10 in-graph: {t10*1000:.1f}ms -> per-pass {4*N*10/t10/1e9:.1f} GB/s")

@@ -1,0 +1,31 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+N = 1 << 27
+rng = np.random.default_rng(0)
+v = rng.integers(100, 1_000_000, N).astype(np.int32)
+dev = jax.devices()[0]
+d_v = jax.device_put(v, dev)
+print("committed:", d_v.committed)
+
+@jax.jit
+def sum1(x):
+    return x.astype(jnp.float32).sum()
+
+def bench(fn, *args, reps=6):
+    out = fn(*args); jax.device_get(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = fn(*args); jax.device_get(out); ts.append(time.perf_counter()-t0)
+    return ts
+
+print("committed put:", [f"{t*1000:.1f}" for t in bench(sum1, d_v)])
+
+# output of a jit as input (definitely device-resident)
+@jax.jit
+def ident(x):
+    return x * 1
+d_v2 = ident(d_v)
+jax.device_get(d_v2[:8])
+print("jit-output input:", [f"{t*1000:.1f}" for t in bench(sum1, d_v2)])

@@ -1,0 +1,64 @@
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+N = 1 << 27
+G = 2406
+W = 64
+CHUNK = 1 << 16
+H = -(-G // W)
+rng = np.random.default_rng(0)
+codes = rng.integers(0, G, N).astype(np.uint16)
+quantity = rng.integers(1, 51, N).astype(np.uint8)
+revenue = rng.integers(100, 1_000_000, N).astype(np.int32)
+d = [jax.device_put(x) for x in (codes, quantity, revenue)]
+
+def one_query(codes, q, v, thresh, n_limbs=3):
+    mask = q < thresh
+    vm = jnp.where(mask, v, 0).astype(jnp.uint32)
+    limbs = [mask.astype(jnp.bfloat16)]
+    for i in range(n_limbs):
+        limbs.append(((vm >> np.uint32(8*i)) & np.uint32(0xFF)).astype(jnp.bfloat16))
+    li = jnp.stack(limbs, axis=1)
+    ki = codes.astype(jnp.int32)
+    L = len(limbs)
+    li = li.reshape(-1, CHUNK, L)
+    ki = ki.reshape(-1, CHUNK)
+    def body(acc, xs):
+        l, kk = xs
+        hi = kk // np.int32(W)
+        lo = kk % np.int32(W)
+        A = jax.nn.one_hot(hi, H, dtype=jnp.bfloat16)
+        B = jax.nn.one_hot(lo, W, dtype=jnp.bfloat16)
+        S = jnp.einsum("cl,ch,cw->lhw", l, A, B, preferred_element_type=jnp.float32)
+        return acc + S, None
+    acc, _ = lax.scan(body, jnp.zeros((L, H, W), jnp.float32), (li, ki))
+    return acc.reshape(L, H*W)[:, :G]
+
+K = 10
+@jax.jit
+def multi(codes, q, v):
+    def body(i, acc):
+        out = one_query(codes, q, v, (25 + i).astype(jnp.uint8))
+        return acc + out.sum()
+    return lax.fori_loop(0, K, body, jnp.float32(0))
+
+out = multi(*d); jax.device_get(out)
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter(); out = multi(*d); jax.device_get(out); ts.append(time.perf_counter()-t0)
+t_multi = float(np.median(ts))
+
+@jax.jit
+def single(codes, q, v):
+    return one_query(codes, q, v, jnp.uint8(25)).sum()
+out = single(*d); jax.device_get(out)
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter(); out = single(*d); jax.device_get(out); ts.append(time.perf_counter()-t0)
+t_single = float(np.median(ts))
+
+per_query = (t_multi - t_single) / (K - 1)
+print(f"single-call: {t_single*1000:.1f}ms; {K}-query call: {t_multi*1000:.1f}ms")
+print(f"marginal per-query: {per_query*1000:.2f}ms -> {N/per_query/1e9:.2f} Grows/s")

@@ -1,0 +1,80 @@
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+N = 1 << 27
+G = 2406
+CHUNK = 1 << 16
+rng = np.random.default_rng(0)
+codes = rng.integers(0, G, N).astype(np.uint16)
+quantity = rng.integers(1, 51, N).astype(np.uint8)
+revenue = rng.integers(100, 1_000_000, N).astype(np.int32)
+d = [jax.device_put(x) for x in (codes, quantity, revenue)]
+
+def kern(codes, q, v, thresh, W, n_limbs, limb_bits=8, U=1, flat=False):
+    H = -(-G // W)
+    mask = q < thresh
+    vm = jnp.where(mask, v, 0).astype(jnp.uint32)
+    limbs = [mask.astype(jnp.bfloat16)]
+    lb = np.uint32(limb_bits)
+    for i in range(n_limbs):
+        limbs.append(((vm >> (lb*np.uint32(i))) & np.uint32((1<<limb_bits)-1)).astype(jnp.bfloat16))
+    li = jnp.stack(limbs, axis=1)
+    ki = codes.astype(jnp.int32)
+    L = len(limbs)
+    C = CHUNK * U
+    li = li.reshape(-1, C, L)
+    ki = ki.reshape(-1, C)
+    def body(acc, xs):
+        l, kk = xs
+        hi = kk // np.int32(W)
+        lo = kk % np.int32(W)
+        A = jax.nn.one_hot(hi, H, dtype=jnp.bfloat16)  # [C, H]
+        B = jax.nn.one_hot(lo, W, dtype=jnp.bfloat16)  # [C, W]
+        if flat:
+            AL = (A[:, None, :] * l[:, :, None]).reshape(C, L*H)
+            S = jnp.matmul(AL.T, B, preferred_element_type=jnp.float32)  # [L*H, W]
+        else:
+            S = jnp.einsum("cl,ch,cw->lhw", l, A, B, preferred_element_type=jnp.float32).reshape(L*H, W)
+        return acc + S, None
+    acc, _ = lax.scan(body, jnp.zeros((L*H, W), jnp.float32), (li, ki))
+    return acc.reshape(L, H*W)[:, :G]
+
+def bench(W, n_limbs, limb_bits=8, U=1, flat=False, K=8):
+    f = functools.partial(kern, W=W, n_limbs=n_limbs, limb_bits=limb_bits, U=U, flat=flat)
+    @jax.jit
+    def multi(codes, q, v):
+        def body(i, acc):
+            return acc + f(codes, q, v, (25 + i).astype(jnp.uint8)).sum()
+        return lax.fori_loop(0, K, body, jnp.float32(0))
+    @jax.jit
+    def single(codes, q, v):
+        return f(codes, q, v, jnp.uint8(25)).sum()
+    for fn, reps in ((multi, 3), (single, 3)):
+        fn(*d) if fn is single else None
+    out = multi(*d); jax.device_get(out)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); out = multi(*d); jax.device_get(out); ts.append(time.perf_counter()-t0)
+    t_multi = float(np.median(ts))
+    out = single(*d); jax.device_get(out)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); out = single(*d); jax.device_get(out); ts.append(time.perf_counter()-t0)
+    t_single = float(np.median(ts))
+    per_q = (t_multi - t_single)/(K-1)
+    print(f"W={W:3d} limbs={n_limbs}x{limb_bits}b U={U} flat={int(flat)}: {per_q*1000:6.2f}ms  {N/per_q/1e9:5.2f} Grows/s")
+
+bench(64, 3)
+bench(128, 3)
+bench(256, 3)
+bench(128, 3, flat=True)
+bench(128, 4, limb_bits=6, U=4)
+bench(256, 4, limb_bits=6, U=4)
+bench(128, 4, limb_bits=6, U=8)
+print("--- limb scaling at W=64 ---")
+bench(64, 1)
+bench(64, 2)
+bench(64, 5)
+bench(64, 3, U=2)

@@ -1,0 +1,71 @@
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+N = 1 << 27
+G = 2406
+rng = np.random.default_rng(0)
+codes = rng.integers(0, G, N).astype(np.uint16)
+quantity = rng.integers(1, 51, N).astype(np.uint8)
+revenue = rng.integers(100, 1_000_000, N).astype(np.int32)
+d = [jax.device_put(x) for x in (codes, quantity, revenue)]
+W = 64
+H = -(-G // W)
+
+def kern_i8(codes, q, v, thresh, n_limbs=3, limb_bits=7, chunk=1<<20):
+    mask = q < thresh
+    vm = jnp.where(mask, v, 0).astype(jnp.uint32)
+    limbs = [mask.astype(jnp.int8)]
+    lb = np.uint32(limb_bits)
+    for i in range(n_limbs):
+        limbs.append(((vm >> (lb*np.uint32(i))) & np.uint32((1<<limb_bits)-1)).astype(jnp.int8))
+    li = jnp.stack(limbs, axis=1)
+    ki = codes.astype(jnp.int32)
+    L = len(limbs)
+    li = li.reshape(-1, chunk, L)
+    ki = ki.reshape(-1, chunk)
+    def body(acc, xs):
+        l, kk = xs
+        hi = kk // np.int32(W)
+        lo = kk % np.int32(W)
+        A = jax.nn.one_hot(hi, H, dtype=jnp.int8)
+        B = jax.nn.one_hot(lo, W, dtype=jnp.int8)
+        S = jnp.einsum("cl,ch,cw->lhw", l, A, B, preferred_element_type=jnp.int32)
+        return acc + S.astype(jnp.float32), None
+    acc, _ = lax.scan(body, jnp.zeros((L, H, W), jnp.float32), (li, ki))
+    return acc.reshape(L, H*W)[:, :G]
+
+def bench(name, f, K=8):
+    @jax.jit
+    def multi(codes, q, v):
+        def body(i, acc):
+            return acc + f(codes, q, v, (25 + i).astype(jnp.uint8)).sum()
+        return lax.fori_loop(0, K, body, jnp.float32(0))
+    @jax.jit
+    def single(codes, q, v):
+        return f(codes, q, v, jnp.uint8(25)).sum()
+    out = multi(*d); jax.device_get(out)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); out = multi(*d); jax.device_get(out); ts.append(time.perf_counter()-t0)
+    t_multi = float(np.median(ts))
+    out = single(*d); jax.device_get(out)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); out = single(*d); jax.device_get(out); ts.append(time.perf_counter()-t0)
+    t_single = float(np.median(ts))
+    per_q = (t_multi - t_single)/(K-1)
+    print(f"{name}: {per_q*1000:6.2f}ms  {N/per_q/1e9:5.2f} Grows/s")
+
+bench("i8 3x7b chunk=1M", functools.partial(kern_i8, n_limbs=3, limb_bits=7, chunk=1<<20))
+bench("i8 3x7b chunk=256K", functools.partial(kern_i8, n_limbs=3, limb_bits=7, chunk=1<<18))
+bench("i8 3x7b chunk=64K", functools.partial(kern_i8, n_limbs=3, limb_bits=7, chunk=1<<16))
+# correctness
+out = jax.jit(functools.partial(kern_i8, n_limbs=3, limb_bits=7, chunk=1<<20))(*d, jnp.uint8(25))
+r = np.asarray(jax.device_get(out), dtype=np.float64)
+m = quantity < 25
+exp_cnt = np.bincount(codes[m], minlength=G)
+exp_sum = np.bincount(codes[m], weights=revenue[m].astype(np.float64), minlength=G)
+got_sum = r[1] + r[2]*(1<<7) + r[3]*(1<<14)
+print("count exact:", np.array_equal(r[0], exp_cnt), "sum exact:", np.array_equal(got_sum, exp_sum))

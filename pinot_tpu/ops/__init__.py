@@ -6,6 +6,8 @@ updates — shared by the SSE planner, the distributed engine and the MSE.
 """
 from pinot_tpu.ops.segmented import (  # noqa: F401
     accum_policy,
+    fused_group_tables,
+    sum_limb_plan,
     group_count,
     group_max,
     group_min,

@@ -250,16 +250,20 @@ class DistributedEngine:
             out_specs = P()
 
         elif kind == "groupby_dense":
+            vranges = planner_mod.agg_vranges(agg_specs, stacked)
 
             def shard_kernel(cols, valid, params):
                 cols = _flat(cols)
                 tmask, _ = filter_fn(cols, params)
                 tmask = tmask & valid.reshape(-1)
                 key = _group_key(cols)
-                presence = lax.psum(ops.group_count(tmask, key, num_groups), axis)
+                inputs = _agg_inputs(cols, params, tmask)
+                presence, partials = planner_mod.grouped_partials(
+                    aggs, inputs, tmask, key, num_groups, vranges
+                )
+                presence = lax.psum(presence, axis)
                 partials = [
-                    {f: _psum_field(f, x, axis) for f, x in fn.partial_grouped(v, m, key, num_groups).items()}
-                    for fn, (v, m) in zip(aggs, _agg_inputs(cols, params, tmask))
+                    {f: _psum_field(f, x, axis) for f, x in p.items()} for p in partials
                 ]
                 return presence, partials
 

@@ -80,7 +80,10 @@ class StackedTable:
         return list(self.columns)
 
     def signature(self) -> Tuple:
-        """Kernel cache key component: shapes + dictionary fingerprints."""
+        """Kernel cache key component: shapes + dictionary fingerprints +
+        stats-derived limb plans (baked into fused group-by kernels)."""
+        from pinot_tpu.query.planner import column_limb_sig
+
         parts: List[Tuple] = [(self.num_shards, self.docs_per_shard)]
         for name, c in sorted(self.columns.items()):
             parts.append(
@@ -89,6 +92,7 @@ class StackedTable:
                     c.dictionary.fingerprint() if c.dictionary else None,
                     str((c.codes if c.codes is not None else c.values).dtype),
                     c.nulls is not None,
+                    column_limb_sig(c),
                 )
             )
         return tuple(parts)

@@ -100,6 +100,7 @@ def execute_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
         total_docs=segment.num_docs,
     )
     plan = planner.plan_segment(ctx, segment)
+    stats.filter_index_uses = tuple(plan.index_uses)
     cols = segment.to_device(device=device, columns=plan.needed_columns)
     params = {k: jax.device_put(v, device) for k, v in plan.params.items()}
 

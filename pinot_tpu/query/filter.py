@@ -51,24 +51,54 @@ def like_to_regex(pattern: str) -> str:
     return "^" + "".join(out) + "$"
 
 
+# IN/NOT_IN/regex tables resolve through the inverted index only up to this
+# many bitmap-row ORs (past it a code scan reads less)
+_INV_MAX_ROWS = 256
+
+
 class FilterCompiler:
     """Compiles one filter tree against one segment.
 
     Produces (a) a params dict of per-segment device constants and (b) an
     eval closure usable inside jit.  Param keys follow traversal order, so
     segments with the same query shape produce structurally identical params
-    pytrees -> one jit cache entry per (query, segment-signature)."""
+    pytrees -> one jit cache entry per (query, segment-signature).
+
+    Index acceleration (round 2 — BitmapBasedFilterOperator /
+    SortedIndexBasedFilterOperator analogs,
+    pinot-core/.../operator/filter/BitmapBasedFilterOperator.java:29):
+      * sorted column + code-range predicate -> contiguous doc range, two
+        int params, ZERO row reads on device;
+      * range index + code-range predicate -> prefix[hi] & ~prefix[lo]
+        resolved host-side from the mmap'd index (n/8 bytes), shipped as a
+        packed-words param and bit-unpacked on device;
+      * inverted index + small dictId set -> OR of bitmap rows, same.
+    The device never rescans the code array for such predicates, and if a
+    column is touched ONLY by index-resolved predicates its codes are never
+    shipped to HBM at all (planner prunes via `used_columns`).
+    `index_uses` records (column, kind) per accelerated predicate for
+    ExecutionStats."""
 
     def __init__(self, segment: ImmutableSegment, null_handling: bool = True):
         self.segment = segment
         self.null_handling = null_handling
         self.params: Params = {}
         self._counter = 0
+        # columns whose device entries the compiled closures will read
+        self.used_columns = set()
+        # (column, "sorted"|"range"|"inverted") per index-accelerated predicate
+        self.index_uses: List[Tuple[str, str]] = []
 
     def _key(self, suffix: str) -> str:
         k = f"f{self._counter}.{suffix}"
         self._counter += 1
         return k
+
+    def _col_index(self, kind: str, name: str):
+        idx = getattr(self.segment, "indexes", None)
+        if not idx:
+            return None
+        return idx.get(kind, {}).get(name)
 
     # ------------------------------------------------------------------
     def compile(self, node: Optional[FilterNode]) -> Callable[[Dict, Dict], MaskPair]:
@@ -136,6 +166,8 @@ class FilterCompiler:
             col = seg.column(p.lhs.op)
             want_null = p.ptype is PredicateType.IS_NULL
             has_nulls = col.nulls is not None and self.null_handling
+            if has_nulls:
+                self.used_columns.add(p.lhs.op)
             n = seg.num_docs
 
             def eval_null(cols, params, _want=want_null, _has=has_nulls, _name=p.lhs.op):
@@ -197,9 +229,15 @@ class FilterCompiler:
 
         has_nulls = col.nulls is not None and self.null_handling
 
+        # -- index-accelerated paths (no code scan) ----------------------
+        accel = self._try_index_paths(name, col, lo_code, hi_code, table, has_nulls)
+        if accel is not None:
+            return accel
+
         if table is not None:
             key = self._key("table")
             self.params[key] = table
+            self.used_columns.add(name)
 
             def eval_table(cols, params, _key=key, _name=name, _has=has_nulls):
                 codes = cols[_name]["codes"].astype(jnp.int32)
@@ -215,6 +253,7 @@ class FilterCompiler:
         hi_key = self._key("hi")
         self.params[lo_key] = np.int32(lo_code)
         self.params[hi_key] = np.int32(hi_code)
+        self.used_columns.add(name)
 
         def eval_range(cols, params, _lo=lo_key, _hi=hi_key, _name=name, _has=has_nulls):
             codes = cols[_name]["codes"].astype(jnp.int32)
@@ -226,6 +265,85 @@ class FilterCompiler:
 
         return eval_range
 
+    # -- index-accelerated predicate compilation -------------------------
+    def _null_guard(self, name: str, has_nulls: bool):
+        if has_nulls:
+            self.used_columns.add(name)
+
+    def _emit_doc_range(self, name: str, d0: int, d1: int, has_nulls: bool):
+        n = self.segment.num_docs
+        lo_key = self._key("d0")
+        hi_key = self._key("d1")
+        self.params[lo_key] = np.int32(d0)
+        self.params[hi_key] = np.int32(d1)
+        self._null_guard(name, has_nulls)
+        self.index_uses.append((name, "sorted"))
+
+        def eval_docrange(cols, params, _lo=lo_key, _hi=hi_key, _name=name, _has=has_nulls):
+            docs = jnp.arange(n, dtype=jnp.int32)
+            t = (docs >= params[_lo]) & (docs < params[_hi])
+            nulls = cols[_name].get("nulls") if _has else None
+            if nulls is not None:
+                t = t & ~nulls
+            return t, nulls
+
+        return eval_docrange
+
+    def _emit_bitmap(self, name: str, words: np.ndarray, kind: str, has_nulls: bool, negate: bool):
+        n = self.segment.num_docs
+        key = self._key("bits")
+        self.params[key] = np.ascontiguousarray(words, dtype=np.uint32)
+        self._null_guard(name, has_nulls)
+        self.index_uses.append((name, kind))
+
+        def eval_bitmap(cols, params, _key=key, _name=name, _has=has_nulls, _neg=negate):
+            w = params[_key]
+            bits = ((w[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)) != 0
+            t = bits.reshape(-1)[:n]
+            if _neg:
+                t = ~t
+            nulls = cols[_name].get("nulls") if _has else None
+            if nulls is not None:
+                t = t & ~nulls
+            return t, nulls
+
+        return eval_bitmap
+
+    def _try_index_paths(self, name, col, lo_code, hi_code, table, has_nulls):
+        """Sorted doc-range > range-index > inverted-index, else None (scan)."""
+        if lo_code is not None:  # code-range predicate (EQ / RANGE)
+            # 1-D codes only: stacked/sharded views are [S, D] and per-table
+            # sortedness says nothing about per-shard flat order
+            if col.stats.is_sorted and col.codes is not None and np.asarray(col.codes).ndim == 1:
+                codes_arr = np.asarray(col.codes)
+                d0 = int(np.searchsorted(codes_arr, lo_code, side="left"))
+                d1 = int(np.searchsorted(codes_arr, hi_code, side="left")) if hi_code > lo_code else d0
+                return self._emit_doc_range(name, d0, d1, has_nulls)
+            rng_idx = self._col_index("range", name)
+            if rng_idx is not None:
+                return self._emit_bitmap(
+                    name, rng_idx.range_bitmap(lo_code, hi_code), "range", has_nulls, False
+                )
+            inv = self._col_index("inverted", name)
+            if inv is not None and (hi_code - lo_code) <= _INV_MAX_ROWS:
+                ids = np.arange(lo_code, hi_code, dtype=np.int64)
+                words = inv.doc_bitmap(ids) if len(ids) else np.zeros(inv.bitmaps.shape[1], np.uint32)
+                return self._emit_bitmap(name, words, "inverted", has_nulls, False)
+            return None
+        # table predicate (IN / NOT_IN / NEQ / regex / LIKE)
+        inv = self._col_index("inverted", name)
+        if inv is None:
+            return None
+        pos = np.nonzero(table)[0]
+        neg_ids = np.nonzero(~table)[0]
+        if len(pos) <= _INV_MAX_ROWS:
+            words = inv.doc_bitmap(pos) if len(pos) else np.zeros(inv.bitmaps.shape[1], np.uint32)
+            return self._emit_bitmap(name, words, "inverted", has_nulls, False)
+        if len(neg_ids) <= _INV_MAX_ROWS:
+            words = inv.doc_bitmap(neg_ids) if len(neg_ids) else np.zeros(inv.bitmaps.shape[1], np.uint32)
+            return self._emit_bitmap(name, words, "inverted", has_nulls, True)
+        return None
+
     # -- raw-value -------------------------------------------------------
     def _compile_value_predicate(self, p: Predicate) -> Callable[[Dict, Dict], MaskPair]:
         seg = self.segment
@@ -233,6 +351,7 @@ class FilterCompiler:
         if pt in (PredicateType.REGEXP_LIKE, PredicateType.LIKE, PredicateType.TEXT_MATCH, PredicateType.JSON_MATCH):
             raise ValueError(f"{pt.value} requires a dictionary-encoded column (lhs={p.lhs})")
         null_handling = self.null_handling
+        self.used_columns.update(c for c in p.lhs.columns() if c != "*")
 
         if pt in (PredicateType.IN, PredicateType.NOT_IN):
             key = self._key("set")

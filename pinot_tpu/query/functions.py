@@ -75,6 +75,10 @@ class AggFunction:
     # partial fields are per-group VECTORS (presence/registers/histograms);
     # such aggs cannot ride the scalar-field host sparse-groupby fallback
     vector_fields: bool = False
+    # field -> entry kind ("count"|"sum"|"sumsq"|"min"|"max") for the fused
+    # dense group-by scan (ops.fused_group_tables); None = the function's own
+    # partial_grouped runs instead (sketch family)
+    field_kinds = None
 
     # -- binding (sketch functions override; see query/sketches.py) ------
     def with_args(self, literal_args) -> "AggFunction":
@@ -113,6 +117,7 @@ class CountFunction(AggFunction):
     name = "count"
     needs_expr = False  # COUNT(*) — COUNT(col) counts non-null via mask
     fields = ("count",)
+    field_kinds = {"count": "count"}
 
     def partial(self, values, mask):
         return {"count": ops.masked_count(mask)}
@@ -135,6 +140,7 @@ class SumFunction(AggFunction):
 
     name = "sum"
     fields = ("sum", "count")
+    field_kinds = {"sum": "sum", "count": "count"}
 
     def partial(self, values, mask):
         return {"sum": ops.masked_sum(values, mask), "count": ops.masked_count(mask)}
@@ -155,6 +161,7 @@ class SumFunction(AggFunction):
 class MinFunction(AggFunction):
     name = "min"
     fields = ("min", "count")
+    field_kinds = {"min": "min", "count": "count"}
 
     def partial(self, values, mask):
         return {"min": ops.masked_min(values, mask), "count": ops.masked_count(mask)}
@@ -175,6 +182,7 @@ class MinFunction(AggFunction):
 class MaxFunction(AggFunction):
     name = "max"
     fields = ("max", "count")
+    field_kinds = {"max": "max", "count": "count"}
 
     def partial(self, values, mask):
         return {"max": ops.masked_max(values, mask), "count": ops.masked_count(mask)}
@@ -197,6 +205,7 @@ class AvgFunction(AggFunction):
 
     name = "avg"
     fields = ("sum", "count")
+    field_kinds = {"sum": "sum", "count": "count"}
 
     def partial(self, values, mask):
         return {"sum": ops.masked_sum(values, mask), "count": ops.masked_count(mask)}
@@ -221,6 +230,7 @@ class MinMaxRangeFunction(AggFunction):
 
     name = "minmaxrange"
     fields = ("min", "max", "count")
+    field_kinds = {"min": "min", "max": "max", "count": "count"}
 
     def partial(self, values, mask):
         return {
@@ -254,6 +264,7 @@ class SumOfSquaresFunction(AggFunction):
 
     name = "_sumsq"
     fields = ("count", "sum", "sumsq")
+    field_kinds = {"count": "count", "sum": "sum", "sumsq": "sumsq"}
 
     def partial(self, values, mask):
         return {

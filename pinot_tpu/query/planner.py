@@ -95,6 +95,8 @@ class SegmentPlan:
     group_dims: List[GroupDim] = field(default_factory=list)
     num_groups: int = 0
     select_columns: List[str] = field(default_factory=list)
+    # (column, index kind) per index-accelerated filter predicate
+    index_uses: List[Tuple[str, str]] = field(default_factory=list)
 
 
 # jit cache: (query fingerprint, segment signature) -> (fn, plan metadata)
@@ -145,6 +147,15 @@ def _segment_signature(
                 c.nulls is not None,
                 raw_range,
                 sketch_extra,
+                column_limb_sig(c),
+                c.stats.is_sorted,
+                tuple(
+                    sorted(
+                        k
+                        for k, by_col in getattr(segment, "indexes", {}).items()
+                        if name in by_col
+                    )
+                ),
             )
         )
     return tuple(sig)
@@ -211,6 +222,24 @@ def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
             seen.add(c)
             out.append(c)
     return out
+
+
+def _non_filter_columns(ctx: QueryContext, segment) -> set:
+    """Columns the kernel needs independent of WHERE / FILTER clauses."""
+    import dataclasses as dc
+
+    def strip(s):
+        if isinstance(s, AggregationSpec) and s.filter is not None:
+            return dc.replace(s, filter=None)
+        return s
+
+    ctx2 = dc.replace(
+        ctx,
+        filter=None,
+        select_list=[strip(s) for s in ctx.select_list],
+        extra_aggregations=[strip(s) for s in ctx.extra_aggregations],
+    )
+    return set(_needed_columns(ctx2, segment))
 
 
 def _group_dim(expr: Expr, segment: ImmutableSegment, null_handling: bool) -> GroupDim:
@@ -311,6 +340,115 @@ def agg_input_codes(spec, fn, segment, cols, mask, null_handling: bool):
     return vals, mask  # values_hash
 
 
+def column_limb_sig(c) -> Optional[Tuple[int, bool]]:
+    """Limb-decomposition plan implied by an int column's stats — part of the
+    kernel cache key because grouped_partials bakes it into the trace."""
+    if c.data_type in (DataType.INT, DataType.LONG, DataType.TIMESTAMP, DataType.BOOLEAN):
+        s = c.stats
+        if s.num_docs and s.min_value is not None:
+            return ops.sum_limb_plan(s.min_value, s.max_value)
+    return None
+
+
+def agg_vranges(agg_specs, table_like) -> List[Optional[Tuple[int, int]]]:
+    """Per-aggregation (min, max) column stats when the input is a bare int
+    column — lets the fused scan drop statically-zero limbs."""
+    out: List[Optional[Tuple[int, int]]] = []
+    for spec in agg_specs:
+        rng = None
+        e = spec.expr
+        if e is not None and e.is_column and e.op != "*":
+            try:
+                c = table_like.column(e.op)
+            except KeyError:
+                c = None
+            if c is not None and c.data_type in (
+                DataType.INT, DataType.LONG, DataType.TIMESTAMP, DataType.BOOLEAN
+            ):
+                s = c.stats
+                if s.num_docs and s.min_value is not None:
+                    rng = (int(s.min_value), int(s.max_value))
+        out.append(rng)
+    return out
+
+
+def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges):
+    """Presence table + per-agg grouped partial dicts for the dense path.
+
+    All additive fields (presence, counts, sums, sums of squares) across ALL
+    aggregations share ONE fused one-hot-matmul scan
+    (ops.fused_group_tables) — one (A, B) one-hot pair per chunk instead of
+    one per table, the single biggest kernel-time win of round 2.  min/max
+    fields scatter (no matmul semiring); sketch functions (field_kinds None)
+    run their own partial_grouped."""
+    entries: List[Tuple] = []
+    slot_of: Dict[Tuple, int] = {}
+
+    def entry_slot(kind, values, mask, limb_plan=None) -> int:
+        k = (kind, id(values) if values is not None else None, id(mask), limb_plan)
+        idx = slot_of.get(k)
+        if idx is None:
+            idx = len(entries)
+            entries.append((kind, values, mask, limb_plan))
+            slot_of[k] = idx
+        return idx
+
+    presence_idx = entry_slot("count", None, tmask)
+    requests: List[Tuple[str, Optional[Dict]]] = []
+    for i, (fn, (vals, mask)) in enumerate(zip(aggs, inputs)):
+        if fn.field_kinds is None:
+            requests.append(("own", None))
+            continue
+        fmap: Dict[str, Tuple[str, Optional[int]]] = {}
+        for field, kind in fn.field_kinds.items():
+            if kind == "count":
+                fmap[field] = ("fused", entry_slot("count", None, mask))
+            elif kind == "sum":
+                v = vals
+                is_int = jnp.issubdtype(v.dtype, jnp.integer)
+                rng = vranges[i] if i < len(vranges) else None
+                if is_int and v.dtype.itemsize > 4 and rng is not None and (
+                    -(1 << 31) <= rng[0] and rng[1] < (1 << 31)
+                ):
+                    v = v.astype(jnp.int32)  # stats prove int32 narrowing safe
+                    is_int = True
+                if is_int and v.dtype.itemsize <= 4:
+                    lp = ops.sum_limb_plan(*rng) if rng is not None else (4, True)
+                    fmap[field] = ("fused", entry_slot("int_sum", v, mask, lp))
+                else:
+                    fmap[field] = ("fused", entry_slot("f32_sum", vals, mask))
+            elif kind == "sumsq":
+                fmap[field] = ("fused", entry_slot("f32_sumsq", vals, mask))
+            else:
+                fmap[field] = (kind, None)  # min/max: scatter below
+        requests.append(("fields", fmap))
+
+    tables = ops.fused_group_tables(entries, key, num_groups)
+
+    def _as_table(idx):
+        t = tables[idx]
+        if entries[idx][0] == "count":
+            return t.astype(jnp.int64)
+        return t
+
+    presence = _as_table(presence_idx)
+    partials: List[Dict] = []
+    for (tag, fmap), fn, (vals, mask) in zip(requests, aggs, inputs):
+        if tag == "own":
+            partials.append(fn.partial_grouped(vals, mask, key, num_groups))
+            continue
+        p: Dict[str, Any] = {}
+        for field, (k2, idx) in fmap.items():
+            if k2 == "fused":
+                p[field] = _as_table(idx)
+            elif k2 == "min":
+                p[field] = ops.group_min(vals, mask, key, num_groups)
+            else:
+                p[field] = ops.group_max(vals, mask, key, num_groups)
+        partials.append(p)
+    return presence, partials
+
+
 def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     needed = _needed_columns(ctx, segment)
     key = (ctx.fingerprint(), _segment_signature(segment, needed, sketch_bound_columns(ctx)))
@@ -341,6 +479,12 @@ def _build_plan(
     agg_filter_fns: List[Optional[Callable]] = []
     for spec in agg_specs:
         agg_filter_fns.append(fc.compile(spec.filter) if spec.filter is not None else None)
+
+    # Columns touched ONLY by index-resolved predicates never ship to device
+    # (the index row already answered them) — the byte-savings half of the
+    # BitmapBasedFilterOperator redesign.
+    keep = _non_filter_columns(ctx, segment) | fc.used_columns
+    needed = [c for c in needed if c in keep]
 
     if ctx.is_aggregate and not ctx.group_by:
         kind = "aggregation"
@@ -404,16 +548,13 @@ def _build_plan(
             return [fn.partial(vals, mask) for fn, (vals, mask) in zip(aggs, _agg_inputs(cols, params, tmask))]
 
     elif kind == "groupby_dense":
+        vranges = agg_vranges(agg_specs, segment)
 
         def kernel(cols, params):
             tmask, _ = filter_fn(cols, params)
             key = _group_key(cols, params)
-            presence = ops.group_count(tmask, key, num_groups)
-            partials = [
-                fn.partial_grouped(vals, mask, key, num_groups)
-                for fn, (vals, mask) in zip(aggs, _agg_inputs(cols, params, tmask))
-            ]
-            return presence, partials
+            inputs = _agg_inputs(cols, params, tmask)
+            return grouped_partials(aggs, inputs, tmask, key, num_groups, vranges)
 
     elif kind == "groupby_sparse":
         # Device computes mask + per-dim codes + agg inputs; host finishes the
@@ -459,4 +600,5 @@ def _build_plan(
         group_dims=group_dims,
         num_groups=num_groups,
         select_columns=select_columns,
+        index_uses=list(fc.index_uses),
     )

@@ -26,6 +26,10 @@ class ExecutionStats:
     total_docs: int = 0
     num_groups: int = 0
     time_ms: float = 0.0
+    # (column, "sorted"|"range"|"inverted") per index-accelerated predicate —
+    # proof that the filter read bitmap/doc-range rows instead of scanning
+    # codes (BitmapBasedFilterOperator analog; see query/filter.py)
+    filter_index_uses: Tuple = ()
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_segments_queried += other.num_segments_queried
@@ -34,6 +38,8 @@ class ExecutionStats:
         self.num_docs_scanned += other.num_docs_scanned
         self.total_docs += other.total_docs
         self.num_groups = max(self.num_groups, other.num_groups)
+        if other.filter_index_uses and not self.filter_index_uses:
+            self.filter_index_uses = other.filter_index_uses
 
 
 @dataclass

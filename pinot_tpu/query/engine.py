@@ -60,8 +60,7 @@ class QueryEngine:
             res, seg_stats = executor.execute_segment(ctx, seg, device=device)
             stats.num_segments_processed += 1
             stats.num_docs_scanned += seg_stats.num_docs_scanned
-            if seg_stats.filter_index_uses and not stats.filter_index_uses:
-                stats.filter_index_uses = seg_stats.filter_index_uses
+            stats.add_index_uses(seg_stats.filter_index_uses)
             results.append(res)
         out = reduce_mod.reduce_results(ctx, results, stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000

@@ -23,6 +23,7 @@ replaced by a Pallas hash table.
 """
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -416,6 +417,20 @@ def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges):
                     lp = ops.sum_limb_plan(*rng) if rng is not None else (4, True)
                     fmap[field] = ("fused", entry_slot("int_sum", v, mask, lp))
                 else:
+                    if is_int:
+                        # the reference accumulates long sums in double
+                        # (exact < 2^53); f32 accumulation is ~2^-24 relative
+                        hint = (
+                            "add column stats bounding the range to int32 for "
+                            "an exact path"
+                            if rng is None
+                            else "value range exceeds int32; no exact path exists"
+                        )
+                        warnings.warn(
+                            "grouped SUM over wide-range int64 column falls back "
+                            f"to f32 accumulation (~2^-24 relative error); {hint}",
+                            stacklevel=2,
+                        )
                     fmap[field] = ("fused", entry_slot("f32_sum", vals, mask))
             elif kind == "sumsq":
                 fmap[field] = ("fused", entry_slot("f32_sumsq", vals, mask))

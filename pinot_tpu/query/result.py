@@ -38,8 +38,14 @@ class ExecutionStats:
         self.num_docs_scanned += other.num_docs_scanned
         self.total_docs += other.total_docs
         self.num_groups = max(self.num_groups, other.num_groups)
-        if other.filter_index_uses and not self.filter_index_uses:
-            self.filter_index_uses = other.filter_index_uses
+        self.add_index_uses(other.filter_index_uses)
+
+    def add_index_uses(self, uses: Tuple) -> None:
+        """Order-preserving dedup-union into filter_index_uses."""
+        if uses:
+            self.filter_index_uses = tuple(
+                dict.fromkeys(self.filter_index_uses + tuple(uses))
+            )
 
 
 @dataclass

@@ -1,0 +1,8 @@
+"""Multi-stage engine (MSE): joins + exchanges as in-graph collectives.
+
+Reference parity: pinot-query-planner + pinot-query-runtime (SURVEY.md 2.3).
+"""
+from pinot_tpu.mse.engine import MultiStageEngine
+from pinot_tpu.mse.plan import JoinPlanError
+
+__all__ = ["MultiStageEngine", "JoinPlanError"]

@@ -1,0 +1,84 @@
+"""In-graph exchanges: the MSE data plane as XLA collectives.
+
+Reference parity: pinot-query-runtime's BlockExchange strategies
+(pinot-query-runtime/.../runtime/operator/exchange/{Hash,Broadcast,
+Singleton,Random}Exchange.java) shipping serialized DataBlocks through gRPC
+mailboxes (GrpcSendingMailbox.java:123) with back-pressure.
+
+Re-design (SURVEY.md 2.6, 5.8): stage-to-stage rows never leave the device.
+An exchange is a collective inside the one compiled program:
+
+  broadcast  -> lax.all_gather over the mesh axis (BroadcastExchange): every
+                device sees the whole (filtered) build side.
+  hash       -> bucketize-by-key-hash + lax.all_to_all (HashExchange): rows
+                land on the device that owns their key partition.
+
+Static shapes: a hash exchange cannot know its per-destination row counts at
+trace time, so rows ride in fixed [ndev, capacity] buckets with a validity
+mask; rows beyond capacity are DROPPED and counted, and the host raises on a
+non-zero overflow (the caller re-runs with a bigger slack — the TPU analog of
+mailbox back-pressure, which blocks instead).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def broadcast_rows(arrays: Dict[str, jnp.ndarray], axis: str) -> Dict[str, jnp.ndarray]:
+    """All devices receive every device's rows, concatenated in mesh order."""
+    return {k: lax.all_gather(v, axis, tiled=True) for k, v in arrays.items()}
+
+
+def hash_dest(key: jnp.ndarray, ndev: int) -> jnp.ndarray:
+    """Destination device per row: murmur-style finalizer over the int64 key
+    so strided key spaces (dates, ids) spread evenly."""
+    k = key.astype(jnp.uint64)
+    k = k ^ (k >> jnp.uint64(33))
+    k = k * jnp.uint64(0xFF51AFD7ED558CCD)
+    k = k ^ (k >> jnp.uint64(33))
+    return (k % jnp.uint64(ndev)).astype(jnp.int32)
+
+
+def hash_repartition(
+    arrays: Dict[str, jnp.ndarray],
+    dest: jnp.ndarray,
+    ok: jnp.ndarray,
+    ndev: int,
+    capacity: int,
+    axis: str,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """HashExchange: send each valid row to device `dest[row]`.
+
+    arrays: per-row payload arrays [N, ...] (same leading dim).
+    dest:   int32 [N] in [0, ndev).
+    ok:     bool [N] — invalid rows are not shipped.
+
+    Returns (received_arrays, received_valid, overflow):
+      received_arrays[k] is [ndev * capacity, ...] — this device's partition
+      of the global row set; received_valid marks real rows; overflow is the
+      GLOBAL number of rows dropped for exceeding per-destination capacity
+      (psum'd — the host must raise when > 0).
+    """
+    n = dest.shape[0]
+    d = jnp.where(ok, dest, jnp.int32(ndev))  # invalid -> out-of-range, dropped
+    order = jnp.argsort(d, stable=True)
+    dsort = d[order]
+    # rank within destination bucket = position - first index of that dest
+    first = jnp.searchsorted(dsort, dsort, side="left")
+    pos = jnp.arange(n, dtype=jnp.int32) - first.astype(jnp.int32)
+    overflow_local = jnp.sum((dsort < ndev) & (pos >= capacity))
+    overflow = lax.psum(overflow_local, axis)
+
+    received: Dict[str, jnp.ndarray] = {}
+    for name, a in arrays.items():
+        buf = jnp.zeros((ndev, capacity) + a.shape[1:], dtype=a.dtype)
+        buf = buf.at[dsort, pos].set(a[order], mode="drop")
+        out = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
+        received[name] = out.reshape((ndev * capacity,) + a.shape[1:])
+    vbuf = jnp.zeros((ndev, capacity), dtype=bool)
+    vbuf = vbuf.at[dsort, pos].set(True, mode="drop")
+    valid = lax.all_to_all(vbuf, axis, split_axis=0, concat_axis=0, tiled=True)
+    return received, valid.reshape(ndev * capacity), overflow

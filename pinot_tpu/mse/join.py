@@ -1,0 +1,40 @@
+"""Join kernel primitives: sorted build side + searchsorted probe.
+
+Reference parity: HashJoinOperator's build/probe phases
+(pinot-query-runtime/.../runtime/operator/HashJoinOperator.java — build a
+key->rows hash table from the right input, probe with left rows).
+
+Re-design: a TPU has no pointer-chasing hash table, but a sort plus binary
+search IS a perfect hash for static shapes: sort the (filtered) build keys
+once, then `searchsorted` every probe key in parallel — O(B log B + P log B)
+of pure vector work that XLA maps onto the VPU.  Build keys must be UNIQUE
+among valid rows (dimension-table primary keys — the star-schema case; the
+planner rejects many-to-many joins up front).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+# sentinel: larger than any real key; invalid build rows sort to the end
+KEY_SENTINEL = jnp.iinfo(jnp.int64).max
+
+
+def lookup_join(
+    build_keys: jnp.ndarray,  # int64 [B]
+    build_valid: jnp.ndarray,  # bool [B]
+    probe_keys: jnp.ndarray,  # int64 [P]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Probe each key against the valid build rows.
+
+    Returns (build_row, match): build_row[p] is the build-side row index
+    whose key equals probe_keys[p] (undefined where match[p] is False);
+    match[p] is the inner-join hit mask."""
+    sort_key = jnp.where(build_valid, build_keys, KEY_SENTINEL)
+    order = jnp.argsort(sort_key)
+    sorted_keys = sort_key[order]
+    pos = jnp.searchsorted(sorted_keys, probe_keys)
+    cand = jnp.clip(pos, 0, sorted_keys.shape[0] - 1)
+    match = (sorted_keys[cand] == probe_keys) & (probe_keys != KEY_SENTINEL)
+    return order[cand], match

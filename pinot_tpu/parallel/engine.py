@@ -55,6 +55,51 @@ def _psum_field(name: str, x, axis: str):
     return lax.pmax(x, axis)
 
 
+def flatten_cols(cols):
+    """[S, D] shard-local row arrays -> flat [S*D] views (device-side)."""
+    out = {}
+    for name, entry in cols.items():
+        e = {}
+        for k, v in entry.items():
+            e[k] = v.reshape(-1) if k in ("codes", "values", "nulls") else v
+        out[name] = e
+    return out
+
+
+def make_agg_inputs(agg_specs, aggs, agg_filter_fns, view, table_like, null_handling):
+    """Per-aggregation (values, mask) input builder usable inside kernels.
+
+    Shared by the distributed SSE combine kernel and the MSE join kernels —
+    the projection/transform step of the hot loop (ProjectionOperator /
+    TransformOperator analog) specialised to one plan."""
+
+    def _agg_inputs(cols, params, base_mask):
+        out = []
+        for spec, fn, ffn in zip(agg_specs, aggs, agg_filter_fns):
+            mask = base_mask
+            if ffn is not None:
+                ft, _ = ffn(cols, params)
+                mask = mask & ft
+            if spec.expr is None:
+                vals = mask
+            elif fn.needs_codes:
+                vals, mask = planner_mod.agg_input_codes(spec, fn, view, cols, mask, null_handling)
+            elif fn.name == "count" and spec.expr.is_column:
+                vals = mask
+                c = table_like.column(spec.expr.op)
+                if c.nulls is not None and null_handling:
+                    mask = mask & ~cols[spec.expr.op]["nulls"]
+            else:
+                vals, nulls = eval_expr(spec.expr, view, cols)
+                vals = as_row_array(vals, mask.shape)
+                if nulls is not None and null_handling:
+                    mask = mask & ~nulls
+            out.append((vals, mask))
+        return out
+
+    return _agg_inputs
+
+
 class _ShardView:
     """Compile-time segment facade over a StackedTable: FilterCompiler and
     transform tracing only consult metadata (dictionaries, nulls, dtypes) and
@@ -106,6 +151,15 @@ class DistributedEngine:
             )
         self.tables[name] = stacked
 
+    def _mse(self):
+        """Join queries route to the multi-stage engine over the same mesh
+        and table registry (MultiStageBrokerRequestHandler delegation analog)."""
+        if not hasattr(self, "_mse_engine"):
+            from pinot_tpu.mse.engine import MultiStageEngine
+
+            self._mse_engine = MultiStageEngine(self.mesh, self.axis, tables=self.tables)
+        return self._mse_engine
+
     # ------------------------------------------------------------------
     def query(self, sql: str) -> ResultTable:
         from pinot_tpu.sql.parser import parse_query
@@ -115,6 +169,8 @@ class DistributedEngine:
     def execute(self, ctx: QueryContext) -> ResultTable:
         import time
 
+        if ctx.joins:
+            return self._mse().execute(ctx)
         t0 = time.perf_counter()
         stacked = self.tables[ctx.table]
         self._inject_sketch_info(ctx, stacked)
@@ -191,39 +247,8 @@ class DistributedEngine:
         planner_mod.guard_sparse_vector_fields(kind, aggs)
 
         null_handling = ctx.null_handling
-
-        def _flat(cols):
-            out = {}
-            for name, entry in cols.items():
-                e = {}
-                for k, v in entry.items():
-                    e[k] = v.reshape(-1) if k in ("codes", "values", "nulls") else v
-                out[name] = e
-            return out
-
-        def _agg_inputs(cols, params, base_mask):
-            out = []
-            for spec, fn, ffn in zip(agg_specs, aggs, agg_filter_fns):
-                mask = base_mask
-                if ffn is not None:
-                    ft, _ = ffn(cols, params)
-                    mask = mask & ft
-                if spec.expr is None:
-                    vals = mask
-                elif fn.needs_codes:
-                    vals, mask = planner_mod.agg_input_codes(spec, fn, view, cols, mask, null_handling)
-                elif fn.name == "count" and spec.expr.is_column:
-                    vals = mask
-                    c = stacked.column(spec.expr.op)
-                    if c.nulls is not None and null_handling:
-                        mask = mask & ~cols[spec.expr.op]["nulls"]
-                else:
-                    vals, nulls = eval_expr(spec.expr, view, cols)
-                    vals = as_row_array(vals, mask.shape)
-                    if nulls is not None and null_handling:
-                        mask = mask & ~nulls
-                out.append((vals, mask))
-            return out
+        _flat = flatten_cols
+        _agg_inputs = make_agg_inputs(agg_specs, aggs, agg_filter_fns, view, stacked, null_handling)
 
         def _group_key(cols):
             key = None

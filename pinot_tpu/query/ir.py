@@ -85,6 +85,35 @@ class Expr:
         return f"{self.op}({', '.join(str(a) for a in self.args)})"
 
 
+def map_expr_columns(e: "Expr", fn) -> "Expr":
+    """Rewrite COLUMN leaves via fn(Expr) -> Expr (identity-preserving)."""
+    if e.kind is ExprKind.COLUMN:
+        return fn(e)
+    if e.kind is ExprKind.CALL:
+        new_args = tuple(map_expr_columns(a, fn) for a in e.args)
+        if new_args != e.args:
+            return Expr(ExprKind.CALL, op=e.op, value=e.value, args=new_args)
+    return e
+
+
+def map_filter_columns(node: Optional["FilterNode"], fn) -> Optional["FilterNode"]:
+    import dataclasses as _dc
+
+    if node is None:
+        return None
+    if node.op is FilterOp.PRED:
+        p = node.predicate
+        new_lhs = map_expr_columns(p.lhs, fn)
+        if new_lhs is not p.lhs:
+            return FilterNode.pred(_dc.replace(p, lhs=new_lhs))
+        return node
+    return FilterNode(
+        node.op,
+        children=tuple(map_filter_columns(c, fn) for c in node.children),
+        predicate=node.predicate,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Predicates & filter tree
 # ---------------------------------------------------------------------------
@@ -213,6 +242,26 @@ class OrderByExpr:
     nulls_last: bool = True
 
 
+@dataclass(frozen=True)
+class JoinClause:
+    """One JOIN ... ON a = b clause (MSE JoinNode analog — the logical join
+    of pinot-query-planner's LogicalJoin; only equi-joins, like the
+    reference's HashJoinOperator key requirement,
+    pinot-query-runtime/.../runtime/operator/HashJoinOperator.java)."""
+
+    table: str
+    alias: Optional[str]
+    join_type: str  # "inner" | "left"
+    left_key: Expr
+    right_key: Expr
+
+    def fingerprint(self) -> str:
+        return (
+            f"join:{self.join_type}:{self.table}:{self.alias or ''}:"
+            f"{self.left_key.fingerprint()}={self.right_key.fingerprint()}"
+        )
+
+
 @dataclass
 class QueryContext:
     """Everything the engine needs for one query (QueryContext.java analog).
@@ -224,6 +273,8 @@ class QueryContext:
     table: str
     select_list: List[Union[Expr, AggregationSpec]]
     select_aliases: List[Optional[str]] = dc_field(default_factory=list)
+    table_alias: Optional[str] = None
+    joins: List[JoinClause] = dc_field(default_factory=list)
     filter: Optional[FilterNode] = None
     group_by: List[Expr] = dc_field(default_factory=list)
     having: Optional[FilterNode] = None
@@ -277,6 +328,7 @@ class QueryContext:
     def fingerprint(self) -> str:
         parts = [
             self.table,
+            "|".join(j.fingerprint() for j in self.joins),
             "|".join(s.fingerprint() for s in self.select_list),
             self.filter.fingerprint() if self.filter else "",
             "|".join(g.fingerprint() for g in self.group_by),

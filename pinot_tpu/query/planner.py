@@ -56,7 +56,10 @@ class GroupDim:
 
     def decode(self, codes: np.ndarray) -> np.ndarray:
         if self.kind == "dict":
-            vals = self.dictionary.get_values(codes)
+            # null_code may be an extra slot past the dictionary (LEFT JOIN
+            # no-match rows, mse/engine.py) — clip before the gather
+            card = self.dictionary.cardinality
+            vals = self.dictionary.get_values(np.minimum(np.asarray(codes), card - 1))
         else:
             vals = codes.astype(np.int64) + self.base
         if self.null_code >= 0:

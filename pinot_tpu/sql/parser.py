@@ -25,6 +25,7 @@ from pinot_tpu.query.ir import (
     ExprKind,
     FilterNode,
     FilterOp,
+    JoinClause,
     OrderByExpr,
     Predicate,
     PredicateType,
@@ -88,6 +89,7 @@ KEYWORDS = {
     "offset", "and", "or", "not", "in", "between", "like", "is", "null",
     "as", "asc", "desc", "nulls", "first", "last", "set", "distinct",
     "true", "false", "filter", "option",
+    "join", "on", "inner", "left", "right", "full", "cross", "outer",
 }
 
 
@@ -219,6 +221,8 @@ class _Parser:
         if self.cur.kind not in ("ident",):
             self.fail("expected table name")
         table = self.advance().value
+        table_alias = self.table_alias()
+        joins = self.join_clauses()
 
         where = None
         if self.accept_kw("where"):
@@ -334,10 +338,53 @@ class _Parser:
                 for pred in having.predicates():
                     _maybe_extra(pred.lhs)
 
+        # Single-table queries: resolve alias.column qualifiers here — the
+        # SSE engines know nothing about aliases (only the MSE resolver
+        # strips qualifiers, and it only runs for join queries).
+        if not joins:
+            from pinot_tpu.query.ir import map_expr_columns, map_filter_columns
+
+            known = {table}
+            if table_alias:
+                known.add(table_alias)
+
+            def strip_q(e: Expr) -> Expr:
+                if "." in e.op:
+                    q, c = e.op.split(".", 1)
+                    if q not in known:
+                        raise SqlParseError(
+                            f"unknown table alias {q!r} in {e.op!r} "
+                            f"(FROM {table}{' ' + table_alias if table_alias else ''})"
+                        )
+                    return Expr.col(c)
+                return e
+
+            def strip_agg(s: AggregationSpec) -> AggregationSpec:
+                return dataclasses.replace(
+                    s,
+                    expr=map_expr_columns(s.expr, strip_q) if s.expr is not None else None,
+                    filter=map_filter_columns(s.filter, strip_q),
+                )
+
+            select_list = [
+                strip_agg(s) if isinstance(s, AggregationSpec) else map_expr_columns(s, strip_q)
+                for s in select_list
+            ]
+            group_by = [map_expr_columns(g, strip_q) for g in group_by]
+            where = map_filter_columns(where, strip_q)
+            having = map_filter_columns(having, strip_q)
+            order_by = [
+                OrderByExpr(map_expr_columns(o.expr, strip_q), o.ascending, o.nulls_last)
+                for o in order_by
+            ]
+            extra_aggs = [strip_agg(s) for s in extra_aggs]
+
         return QueryContext(
             table=table,
             select_list=select_list,
             select_aliases=aliases,
+            table_alias=table_alias,
+            joins=joins,
             filter=where,
             group_by=group_by,
             having=having,
@@ -347,6 +394,41 @@ class _Parser:
             options=options,
             extra_aggregations=extra_aggs,
         )
+
+    # -- FROM clause: aliases + joins -----------------------------------
+    def table_alias(self) -> Optional[str]:
+        if self.accept_kw("as"):
+            if self.cur.kind != "ident":
+                self.fail("expected table alias after AS")
+            return self.advance().value
+        if self.cur.kind == "ident":
+            return self.advance().value
+        return None
+
+    def join_clauses(self) -> List[JoinClause]:
+        joins: List[JoinClause] = []
+        while self.at_kw("join", "inner", "left", "right", "full", "cross"):
+            jt = "inner"
+            if self.accept_kw("inner"):
+                pass
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                jt = "left"
+            elif self.at_kw("right", "full", "cross"):
+                self.fail(f"{self.cur.value.upper()} JOIN is not supported (INNER/LEFT only)")
+            self.expect_kw("join")
+            if self.cur.kind != "ident":
+                self.fail("expected table name after JOIN")
+            tbl = self.advance().value
+            alias = self.table_alias()
+            self.expect_kw("on")
+            lhs = self.expr()
+            self.expect_op("=")
+            rhs = self.expr()
+            if not (lhs.is_column and rhs.is_column):
+                self.fail("JOIN ON requires column = column (equi-join keys)")
+            joins.append(JoinClause(tbl, alias, jt, lhs, rhs))
+        return joins
 
     # -- select items ----------------------------------------------------
     def select_item(self) -> Tuple[Union[Expr, AggregationSpec], Optional[str]]:
@@ -601,6 +683,11 @@ class _Parser:
                         args.append(self.expr())
                 self.expect_op(")")
                 return Expr.call(name, *args)
+            # qualified reference: alias.column (resolved by the MSE planner)
+            if self.accept_op("."):
+                if self.cur.kind not in ("ident", "kw"):
+                    self.fail("expected column name after '.'")
+                return Expr.col(f"{name}.{self.advance().value}")
             return Expr.col(name)
         self.fail("expected expression")
 

@@ -1,0 +1,329 @@
+"""MSE join tests: star joins vs sqlite on the 8-device CPU mesh.
+
+Reference test-strategy parity: the golden-file join suites
+(pinot-query-runtime/src/test/resources/queries/Joins.json checked against
+H2, SURVEY.md 4.3) — here sqlite3 is the reference engine and the mock
+cluster is the virtual 8-device mesh (SURVEY.md 4.5).
+"""
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.mse import JoinPlanError, MultiStageEngine
+from pinot_tpu.parallel.engine import DistributedEngine
+from pinot_tpu.parallel.stacked import StackedTable
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+
+def make_ssb(rng, n_fact=5000, n_dim=400):
+    """Toy SSB: lineorder fact + date dimension."""
+    datekeys = (19920101 + np.arange(n_dim) * 7).astype(np.int64)
+    years = 1992 + (np.arange(n_dim) // 53).astype(np.int64)
+    months = 1 + (np.arange(n_dim) % 12).astype(np.int64)
+    date_schema = Schema(
+        name="dates",
+        fields=[
+            FieldSpec("d_datekey", DataType.INT),
+            FieldSpec("d_year", DataType.INT),
+            FieldSpec("d_month", DataType.INT),
+        ],
+    )
+    dates = {"d_datekey": datekeys, "d_year": years, "d_month": months}
+
+    lo_schema = Schema(
+        name="lineorder",
+        fields=[
+            FieldSpec("lo_orderdate", DataType.INT),
+            FieldSpec("lo_revenue", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("lo_discount", DataType.INT, role=FieldRole.METRIC),
+            FieldSpec("lo_region", DataType.STRING),
+        ],
+    )
+    lineorder = {
+        # ~10% of fact keys miss the dim table (exercise inner-join drops)
+        "lo_orderdate": rng.choice(
+            np.concatenate([datekeys, datekeys[:1] - 99]), n_fact
+        ).astype(np.int64),
+        "lo_revenue": rng.integers(1, 10_000, n_fact).astype(np.int64),
+        "lo_discount": rng.integers(0, 11, n_fact).astype(np.int64),
+        "lo_region": rng.choice(["asia", "europe", "americas"], n_fact),
+    }
+    return (lo_schema, lineorder), (date_schema, dates)
+
+
+def sqlite_rows(lineorder, dates, sql):
+    con = sqlite3.connect(":memory:")
+    con.execute("CREATE TABLE lineorder (lo_orderdate, lo_revenue, lo_discount, lo_region)")
+    con.execute("CREATE TABLE dates (d_datekey, d_year, d_month)")
+    con.executemany(
+        "INSERT INTO lineorder VALUES (?,?,?,?)",
+        list(zip(*(np.asarray(lineorder[c]).tolist() for c in
+                   ("lo_orderdate", "lo_revenue", "lo_discount", "lo_region")))),
+    )
+    con.executemany(
+        "INSERT INTO dates VALUES (?,?,?)",
+        list(zip(*(np.asarray(dates[c]).tolist() for c in ("d_datekey", "d_year", "d_month")))),
+    )
+    rows = con.execute(sql).fetchall()
+    con.close()
+    return rows
+
+
+@pytest.fixture(scope="module")
+def engines():
+    rng = np.random.default_rng(7)
+    (lo_schema, lineorder), (date_schema, dates) = make_ssb(rng)
+    eng = DistributedEngine()
+    eng.register_table("lineorder", StackedTable.build(lo_schema, lineorder, eng.num_devices))
+    eng.register_table("dates", StackedTable.build(date_schema, dates, eng.num_devices))
+    return eng, lineorder, dates
+
+
+STRATEGIES = ["broadcast", "shuffle"]
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_join_groupby_dim_attr(engines, strategy):
+    """BASELINE config 5: group by dim attribute, sum fact measure."""
+    eng, lineorder, dates = engines
+    sql = (
+        "SELECT d_year, SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey "
+        "GROUP BY d_year ORDER BY d_year LIMIT 100"
+    )
+    res = eng.query(f"SET joinStrategy = '{strategy}'; " + sql)
+    exp = sqlite_rows(
+        lineorder, dates,
+        "SELECT d_year, SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey GROUP BY d_year ORDER BY d_year",
+    )
+    got = [(int(r[0]), int(r[1])) for r in res.rows]
+    assert got == [(int(a), int(b)) for a, b in exp]
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_join_filters_both_sides(engines, strategy):
+    eng, lineorder, dates = engines
+    res = eng.query(
+        f"SET joinStrategy = '{strategy}'; "
+        "SELECT d_year, COUNT(*), SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey "
+        "WHERE lo_discount BETWEEN 1 AND 3 AND d_month <= 6 "
+        "GROUP BY d_year ORDER BY d_year LIMIT 100"
+    )
+    exp = sqlite_rows(
+        lineorder, dates,
+        "SELECT d_year, COUNT(*), SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey "
+        "WHERE lo_discount BETWEEN 1 AND 3 AND d_month <= 6 "
+        "GROUP BY d_year ORDER BY d_year",
+    )
+    got = [(int(r[0]), int(r[1]), int(r[2])) for r in res.rows]
+    assert got == [tuple(int(x) for x in r) for r in exp]
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_join_scalar_agg(engines, strategy):
+    eng, lineorder, dates = engines
+    res = eng.query(
+        f"SET joinStrategy = '{strategy}'; "
+        "SELECT SUM(lo_revenue), COUNT(*) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey WHERE d_year = 1994"
+    )
+    exp = sqlite_rows(
+        lineorder, dates,
+        "SELECT SUM(lo_revenue), COUNT(*) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey WHERE d_year = 1994",
+    )[0]
+    assert int(res.rows[0][0]) == int(exp[0])
+    assert int(res.rows[0][1]) == int(exp[1])
+
+
+def test_join_groupby_mixed_fact_dim(engines):
+    """Group keys from both sides of the join."""
+    eng, lineorder, dates = engines
+    res = eng.query(
+        "SELECT lo_region, d_year, SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey "
+        "GROUP BY lo_region, d_year ORDER BY lo_region, d_year LIMIT 1000"
+    )
+    exp = sqlite_rows(
+        lineorder, dates,
+        "SELECT lo_region, d_year, SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey "
+        "GROUP BY lo_region, d_year ORDER BY lo_region, d_year",
+    )
+    got = [(r[0], int(r[1]), int(r[2])) for r in res.rows]
+    assert got == [(a, int(b), int(c)) for a, b, c in exp]
+
+
+def test_left_join_groupby(engines):
+    eng, lineorder, dates = engines
+    res = eng.query(
+        "SELECT d_year, COUNT(*) FROM lineorder "
+        "LEFT JOIN dates ON lo_orderdate = d_datekey "
+        "GROUP BY d_year ORDER BY d_year NULLS LAST LIMIT 100"
+    )
+    exp = sqlite_rows(
+        lineorder, dates,
+        "SELECT d_year, COUNT(*) FROM lineorder "
+        "LEFT JOIN dates ON lo_orderdate = d_datekey "
+        "GROUP BY d_year ORDER BY d_year NULLS LAST",
+    )
+    got = [(None if r[0] is None else int(r[0]), int(r[1])) for r in res.rows]
+    assert got == [(None if a is None else int(a), int(b)) for a, b in exp]
+
+
+def test_qualified_refs_and_aliases(engines):
+    eng, lineorder, dates = engines
+    res = eng.query(
+        "SELECT d.d_year, SUM(lo.lo_revenue) FROM lineorder lo "
+        "JOIN dates d ON lo.lo_orderdate = d.d_datekey "
+        "WHERE lo.lo_discount > 5 GROUP BY d.d_year ORDER BY d.d_year LIMIT 100"
+    )
+    exp = sqlite_rows(
+        lineorder, dates,
+        "SELECT d_year, SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey "
+        "WHERE lo_discount > 5 GROUP BY d_year ORDER BY d_year",
+    )
+    got = [(int(r[0]), int(r[1])) for r in res.rows]
+    assert got == [(int(a), int(b)) for a, b in exp]
+
+
+def test_join_error_paths(engines):
+    eng, _, _ = engines
+    with pytest.raises(JoinPlanError):
+        eng.query("SELECT COUNT(*) FROM lineorder JOIN nope ON lo_orderdate = d_datekey")
+    with pytest.raises(JoinPlanError):
+        # unknown alias qualifier
+        eng.query(
+            "SELECT x.d_year, COUNT(*) FROM lineorder JOIN dates ON lo_orderdate = d_datekey "
+            "GROUP BY x.d_year"
+        )
+    with pytest.raises(NotImplementedError):
+        # many-to-many: join fact to itself-like dup-key table
+        eng2 = DistributedEngine()
+        rng = np.random.default_rng(0)
+        s = Schema(name="dup", fields=[FieldSpec("k", DataType.INT), FieldSpec("v", DataType.INT)])
+        eng2.register_table(
+            "dup",
+            StackedTable.build(
+                s, {"k": rng.integers(0, 5, 64), "v": np.arange(64)}, eng2.num_devices
+            ),
+        )
+        f = Schema(name="f", fields=[FieldSpec("fk", DataType.INT), FieldSpec("m", DataType.INT, role=FieldRole.METRIC)])
+        eng2.register_table(
+            "f",
+            StackedTable.build(
+                f, {"fk": rng.integers(0, 5, 64), "m": np.arange(64)}, eng2.num_devices
+            ),
+        )
+        eng2.query("SELECT v, SUM(m) FROM f JOIN dup ON fk = k GROUP BY v")
+
+
+def test_singletable_alias_qualifiers(engines):
+    """alias.column on a NO-join query resolves (regression: raw KeyError)."""
+    eng, lineorder, _ = engines
+    res = eng.query("SELECT tt.lo_region, COUNT(*) FROM lineorder tt GROUP BY tt.lo_region ORDER BY tt.lo_region LIMIT 10")
+    exp = {}
+    for r in np.asarray(lineorder["lo_region"]):
+        exp[r] = exp.get(r, 0) + 1
+    got = {r[0]: int(r[1]) for r in res.rows}
+    assert got == exp
+    from pinot_tpu.sql.parser import SqlParseError
+
+    with pytest.raises(SqlParseError):
+        eng.query("SELECT nope.lo_region FROM lineorder tt LIMIT 1")
+
+
+def test_bad_join_strategy_rejected(engines):
+    eng, _, _ = engines
+    with pytest.raises(ValueError, match="joinStrategy"):
+        eng.query(
+            "SET joinStrategy = 'hash'; SELECT COUNT(*) FROM lineorder "
+            "JOIN dates ON lo_orderdate = d_datekey"
+        )
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_join_groupby_long_rawint_beyond_int32(strategy):
+    """Regression: LONG metric group column with values past int32 must not
+    wrap/crash in the MSE group-code paths."""
+    rng = np.random.default_rng(3)
+    n = 512
+    base = 5_000_000_000
+    fact_schema = Schema(
+        name="f2",
+        fields=[
+            FieldSpec("fk", DataType.INT),
+            FieldSpec("bucket", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("m", DataType.INT, role=FieldRole.METRIC),
+        ],
+    )
+    fact = {
+        "fk": rng.integers(0, 50, n).astype(np.int64),
+        "bucket": (base + rng.integers(0, 4, n)).astype(np.int64),
+        "m": rng.integers(0, 100, n).astype(np.int64),
+    }
+    dim_schema = Schema(
+        name="d2", fields=[FieldSpec("dk", DataType.INT), FieldSpec("grp", DataType.INT)]
+    )
+    dim = {"dk": np.arange(50, dtype=np.int64), "grp": (np.arange(50) % 5).astype(np.int64)}
+    eng = DistributedEngine()
+    eng.register_table("f2", StackedTable.build(fact_schema, fact, eng.num_devices))
+    eng.register_table("d2", StackedTable.build(dim_schema, dim, eng.num_devices))
+    # tiny shards + 50 distinct keys skew the hash partition; widen slack
+    res = eng.query(
+        f"SET joinStrategy = '{strategy}'; SET shuffleSlack = 8; "
+        "SELECT bucket, SUM(m) FROM f2 JOIN d2 ON fk = dk "
+        "GROUP BY bucket ORDER BY bucket LIMIT 10"
+    )
+    exp = {}
+    for b, m in zip(fact["bucket"], fact["m"]):
+        exp[int(b)] = exp.get(int(b), 0) + int(m)
+    got = {int(r[0]): int(r[1]) for r in res.rows}
+    assert got == exp
+
+
+def test_left_join_nullable_dim_attr_null_group():
+    """Regression: LEFT JOIN group-by on a nullable dim attribute must merge
+    stored-NULL rows and no-match rows into ONE SQL NULL group."""
+    rng = np.random.default_rng(11)
+    n = 256
+    fact_schema = Schema(
+        name="f3",
+        fields=[FieldSpec("fk", DataType.INT), FieldSpec("m", DataType.INT, role=FieldRole.METRIC)],
+    )
+    fact = {"fk": rng.integers(0, 40, n).astype(np.int64), "m": np.ones(n, dtype=np.int64)}
+    dim_schema = Schema(
+        name="d3",
+        fields=[FieldSpec("dk", DataType.INT), FieldSpec("dv", DataType.INT, nullable=True)],
+    )
+    dvals = [None if i % 3 == 0 else (10 if i % 2 else 20) for i in range(30)]  # dks 0..29 only
+    dim = {"dk": np.arange(30, dtype=np.int64), "dv": np.array(dvals, dtype=object)}
+    eng = DistributedEngine()
+    eng.register_table("f3", StackedTable.build(fact_schema, fact, eng.num_devices))
+    eng.register_table("d3", StackedTable.build(dim_schema, dim, eng.num_devices))
+    res = eng.query(
+        "SELECT dv, COUNT(*) FROM f3 LEFT JOIN d3 ON fk = dk GROUP BY dv ORDER BY dv NULLS LAST LIMIT 10"
+    )
+    exp = {}
+    dmap = {i: dvals[i] for i in range(30)}
+    for fk in fact["fk"]:
+        v = dmap.get(int(fk))  # None for stored-NULL AND for fk >= 30
+        exp[v] = exp.get(v, 0) + 1
+    got = {r[0]: int(r[1]) for r in res.rows}
+    assert got == exp
+
+
+def test_shuffle_overflow_raises(engines):
+    """Tiny slack forces bucket overflow -> clear error, not silent drops."""
+    eng, _, _ = engines
+    with pytest.raises(RuntimeError, match="shuffleSlack"):
+        eng.query(
+            "SET joinStrategy = 'shuffle'; SET shuffleSlack = 0.01; "
+            "SELECT d_year, SUM(lo_revenue) FROM lineorder "
+            "JOIN dates ON lo_orderdate = d_datekey GROUP BY d_year"
+        )

@@ -89,6 +89,9 @@ class _MsePlan:
     num_groups: int
     strategy: str  # "broadcast" | "shuffle"
     rq: ResolvedQuery
+    # namespace -> param keys sharded on the device axis (index bitmaps)
+    sharded_by_ns: Dict[str, frozenset] = None
+    index_uses: Tuple = ()
 
 
 class MultiStageEngine:
@@ -140,9 +143,18 @@ class MultiStageEngine:
             c, v = st.to_device(self.mesh, self.axis, plan.dim_needed[j.table])
             dim_cols.append(c)
             dim_valids.append(v)
-        params = jax.tree.map(
-            lambda v: jax.device_put(v, NamedSharding(self.mesh, P())), plan.params
-        )
+        stats.add_index_uses(plan.index_uses)
+        rep = NamedSharding(self.mesh, P())
+        row = NamedSharding(self.mesh, P(self.axis, None))
+        params = {}
+        for k, v in plan.params.items():
+            if isinstance(v, dict):
+                ns = (plan.sharded_by_ns or {}).get(k, frozenset())
+                params[k] = {
+                    k2: jax.device_put(v2, row if k2 in ns else rep) for k2, v2 in v.items()
+                }
+            else:
+                params[k] = jax.device_put(v, rep)
         result = self._run(rq.ctx, plan, fact_cols, fact_valid, dim_cols, dim_valids, params, stats)
         out = reduce_mod.reduce_results(rq.ctx, [result], stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
@@ -301,10 +313,12 @@ class MultiStageEngine:
         ndev = self.num_devices
         fact_st = self.tables[rq.fact]
         local_rows = (fact_st.num_shards // ndev) * fact_st.docs_per_shard
-        fact_view = _ShardView(fact_st, local_rows)
+        fact_view = _ShardView(fact_st, local_rows, axis=axis, ndev=ndev)
         null_handling = ctx.null_handling
 
         params: Dict[str, Any] = {}
+        sharded_by_ns: Dict[str, frozenset] = {}
+        index_uses: List[Tuple[str, str]] = []
         fc_fact = FilterCompiler(fact_view, null_handling)
         fact_filter_fn = fc_fact.compile(rq.fact_filter)
         params["fact"] = fc_fact.params
@@ -312,14 +326,18 @@ class MultiStageEngine:
         join_plans: List[_JoinPlan] = []
         dim_filter_fns: List[Callable] = []
         dim_views: List[Any] = []
+        dim_used_columns: List[set] = []
         for i, rj in enumerate(rq.joins):
             dim_st = self.tables[rj.table]
             d_local = (dim_st.num_shards // ndev) * dim_st.docs_per_shard
-            dview = _ShardView(dim_st, d_local)
+            dview = _ShardView(dim_st, d_local, axis=axis, ndev=ndev)
             dim_views.append(dview)
             fc = FilterCompiler(dview, null_handling)
             dim_filter_fns.append(fc.compile(rq.dim_filters[rj.table]))
             params[f"dimf{i}"] = fc.params
+            sharded_by_ns[f"dimf{i}"] = frozenset(fc.row_sharded_params)
+            index_uses.extend(fc.index_uses)
+            dim_used_columns.append(set(fc.used_columns))
             join_plans.append(self._key_plan(i, rq, params))
 
         # -- aggregations (fact-side inputs only) ------------------------
@@ -340,6 +358,8 @@ class MultiStageEngine:
         agg_inputs_fn = make_agg_inputs(
             agg_specs, aggs, agg_filter_fns, fact_view, fact_st, null_handling
         )
+        sharded_by_ns["fact"] = frozenset(fc_fact.row_sharded_params)
+        index_uses.extend(fc_fact.index_uses)
 
         # -- group dimensions --------------------------------------------
         group_dims: List[GroupDim] = []
@@ -390,24 +410,21 @@ class MultiStageEngine:
                 if c != "*" and c not in fact_needed:
                     fact_needed.append(c)
 
-        if rq.fact_filter is not None:
-            need_fact(rq.fact_filter.columns())
+        # filter-scanned columns come from the compiler's used set — columns
+        # whose predicates resolved through an index never ship to device
+        need_fact(sorted(fc_fact.used_columns))
         for s in agg_specs:
             if s.expr is not None:
                 need_fact(s.expr.columns())
-            if s.filter is not None:
-                need_fact(s.filter.columns())
         for jp in join_plans:
             need_fact([jp.fact_key])
         for g, di in zip(ctx.group_by, dim_of_group):
             if di is None:
                 need_fact([g.op])
         dim_needed: Dict[str, List[str]] = {}
-        for i, jp in enumerate(join_plans):
+        for i, (jp, dview) in enumerate(zip(join_plans, dim_views)):
             cols = [jp.dim_key] + list(jp.attrs)
-            f = rq.dim_filters[jp.dim_table]
-            if f is not None:
-                cols += [c for c in f.columns() if c not in cols]
+            cols += [c for c in sorted(dim_used_columns[i]) if c not in cols]
             dim_needed[jp.dim_table] = cols
 
         # -- dim attr array access (codes for dict, raw values otherwise) --
@@ -561,6 +578,16 @@ class MultiStageEngine:
 
         mesh = self.mesh
 
+        def _param_specs(params):
+            out = {}
+            for k, v in params.items():
+                if isinstance(v, dict):
+                    ns = sharded_by_ns.get(k, frozenset())
+                    out[k] = {k2: (P(axis, None) if k2 in ns else P()) for k2 in v}
+                else:
+                    out[k] = P()
+            return out
+
         def run(fact_cols, fact_valid, dim_cols_list, dim_valids, params):
             kern = jax.shard_map(
                 shard_kernel,
@@ -570,7 +597,7 @@ class MultiStageEngine:
                     P(axis, None),
                     tuple(_col_specs(c) for c in dim_cols_list),
                     tuple(P(axis, None) for _ in dim_valids),
-                    jax.tree.map(lambda _: P(), params),
+                    _param_specs(params),
                 ),
                 out_specs=(P(), P()),
                 check_vma=False,
@@ -589,6 +616,8 @@ class MultiStageEngine:
             num_groups=num_groups,
             strategy=strategy,
             rq=rq,
+            sharded_by_ns=sharded_by_ns,
+            index_uses=tuple(index_uses),
         )
 
     # ------------------------------------------------------------------
@@ -604,18 +633,13 @@ class MultiStageEngine:
             return AggSegmentResult(partials=jax.device_get(out))
         presence, partials = jax.device_get(out)
         presence = np.asarray(presence)
+        shim = SimpleNamespace(group_dims=plan.group_dims, aggs=plan.aggs)
         dense = DenseGroupData(
             presence=presence,
             partials=partials,
-            key_space=tuple(
-                ("dict", gd.name, gd.dictionary.fingerprint(), gd.null_code)
-                if gd.kind == "dict"
-                else ("rawint", gd.name, gd.base, gd.cardinality)
-                for gd in plan.group_dims
-            ),
+            key_space=sse_executor._key_space_id(shim),
             group_dims=plan.group_dims,
         )
-        shim = SimpleNamespace(group_dims=plan.group_dims, aggs=plan.aggs)
         keys, sliced = sse_executor._dense_to_present(shim, presence, partials, ctx.num_groups_limit)
         stats.num_groups = len(keys[0]) if keys else 0
         return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense)

@@ -104,12 +104,19 @@ class _ShardView:
     """Compile-time segment facade over a StackedTable: FilterCompiler and
     transform tracing only consult metadata (dictionaries, nulls, dtypes) and
     num_docs for match-all shapes — here num_docs is the per-device flat row
-    count (local shards x docs_per_shard)."""
+    count (local shards x docs_per_shard).
 
-    def __init__(self, stacked, local_rows: int):
+    When axis/ndev are given, FilterCompiler compiles SHARD-AWARE index
+    paths: bitmap params split on the device axis, doc ranges compare
+    against global flat doc ids (query/filter.py shard_info)."""
+
+    def __init__(self, stacked, local_rows: int, axis: Optional[str] = None, ndev: int = 0):
         self._stacked = stacked
         self.num_docs = local_rows
         self.schema = stacked.schema
+        self.total_docs = stacked.num_docs
+        self.indexes = getattr(stacked, "indexes", {})
+        self.shard_info = (axis, ndev, local_rows) if axis is not None else None
 
     def column(self, name: str):
         return self._stacked.column(name)
@@ -125,6 +132,10 @@ class _DistPlan:
     group_dims: List[GroupDim]
     num_groups: int
     select_columns: List[str]
+    # param keys sharded on the device axis (index bitmap word slices)
+    row_sharded_params: frozenset = frozenset()
+    # (column, index kind) per index-accelerated filter predicate
+    index_uses: Tuple = ()
 
 
 class DistributedEngine:
@@ -181,6 +192,7 @@ class DistributedEngine:
             total_docs=stacked.num_docs,
         )
         plan = self._plan(ctx, stacked)
+        stats.add_index_uses(plan.index_uses)
         cols, valid = stacked.to_device(self.mesh, self.axis, plan.needed_columns)
         result = self._run(ctx, plan, stacked, cols, valid, stats)
         out = reduce_mod.reduce_results(ctx, [result], stats)
@@ -221,7 +233,7 @@ class DistributedEngine:
         ndev = self.num_devices
         local_shards = stacked.num_shards // ndev
         local_rows = local_shards * stacked.docs_per_shard
-        view = _ShardView(stacked, local_rows)
+        view = _ShardView(stacked, local_rows, axis=axis, ndev=ndev)
 
         fc = FilterCompiler(view, ctx.null_handling)
         filter_fn = fc.compile(ctx.filter)
@@ -346,12 +358,17 @@ class DistributedEngine:
                     raise NotImplementedError(f"selection expression {s} not yet supported")
 
         mesh = self.mesh
+        row_sharded = frozenset(fc.row_sharded_params)
 
         def run(cols, valid, params):
             kern = jax.shard_map(
                 shard_kernel,
                 mesh=mesh,
-                in_specs=(_col_specs(cols), P(axis, None), jax.tree.map(lambda _: P(), params)),
+                in_specs=(
+                    _col_specs(cols),
+                    P(axis, None),
+                    {k: (P(axis, None) if k in row_sharded else P()) for k in params},
+                ),
                 out_specs=out_specs,
                 check_vma=False,
             )
@@ -360,6 +377,12 @@ class DistributedEngine:
         fn = jax.jit(run)
 
         needed = sse_executor_needed_columns(ctx, stacked)
+        # index-resolved filter columns never ship to device (the bitmap/doc
+        # range already answered them) — same pruning as the SSE planner
+        keep = planner_mod._non_filter_columns(ctx, view) | fc.used_columns
+        if kind == "selection":
+            keep |= set(select_columns) | {o.expr.op for o in ctx.order_by if o.expr.is_column}
+        needed = [c for c in needed if c in keep]
         return _DistPlan(
             kind=kind,
             fn=fn,
@@ -369,11 +392,21 @@ class DistributedEngine:
             group_dims=group_dims,
             num_groups=num_groups,
             select_columns=select_columns,
+            row_sharded_params=frozenset(fc.row_sharded_params),
+            index_uses=tuple(fc.index_uses),
         )
 
     # ------------------------------------------------------------------
     def _run(self, ctx, plan: _DistPlan, stacked, cols, valid, stats: ExecutionStats):
-        params = {k: jax.device_put(v, NamedSharding(self.mesh, P())) for k, v in plan.params.items()}
+        params = {
+            k: jax.device_put(
+                v,
+                NamedSharding(
+                    self.mesh, P(self.axis, None) if k in plan.row_sharded_params else P()
+                ),
+            )
+            for k, v in plan.params.items()
+        }
 
         if plan.kind == "aggregation":
             partials = jax.device_get(plan.fn(cols, valid, params))

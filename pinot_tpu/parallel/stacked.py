@@ -46,6 +46,9 @@ class StackedColumn:
         return self.dictionary.cardinality if self.dictionary else self.stats.cardinality
 
 
+_BUILD_COUNTER = 0
+
+
 class StackedTable:
     """A table resident as stacked columns, ready to shard over a device mesh.
 
@@ -60,13 +63,27 @@ class StackedTable:
         columns: Dict[str, StackedColumn],
         valid: np.ndarray,  # [S, D] bool
         num_docs: int,
+        indexes: Optional[Dict[str, Dict[str, Any]]] = None,
     ):
         self.schema = schema
         self.columns = columns
         self.valid = valid
         self.num_docs = num_docs
         self.num_shards, self.docs_per_shard = valid.shape
+        # {"inverted"|"range": {column: index}} over the FLAT PADDED doc
+        # space (num_shards * docs_per_shard rows) — docs_per_shard is
+        # 32-aligned so per-device bitmap word slices stay word-aligned
+        # (query/filter.py shard-aware params)
+        self.indexes: Dict[str, Dict[str, Any]] = indexes or {}
         self._device_cache: Dict[Any, Any] = {}
+        # Per-instance nonce in signature(): compiled plans bake ROW-DATA
+        # dependent params (sorted doc ranges, index bitmap words), which
+        # dictionary fingerprints alone cannot distinguish — two tables with
+        # identical shapes/dictionaries but different row content must never
+        # share cached plans.
+        global _BUILD_COUNTER
+        _BUILD_COUNTER += 1
+        self._build_nonce = _BUILD_COUNTER
 
     # -- facade used by FilterCompiler / planner at compile time ---------
     def column(self, name: str) -> StackedColumn:
@@ -84,7 +101,7 @@ class StackedTable:
         stats-derived limb plans (baked into fused group-by kernels)."""
         from pinot_tpu.query.planner import column_limb_sig
 
-        parts: List[Tuple] = [(self.num_shards, self.docs_per_shard)]
+        parts: List[Tuple] = [(self.num_shards, self.docs_per_shard, self._build_nonce)]
         for name, c in sorted(self.columns.items()):
             parts.append(
                 (
@@ -93,6 +110,8 @@ class StackedTable:
                     str((c.codes if c.codes is not None else c.values).dtype),
                     c.nulls is not None,
                     column_limb_sig(c),
+                    c.stats.is_sorted,
+                    tuple(sorted(k for k, by_col in self.indexes.items() if name in by_col)),
                 )
             )
         return tuple(parts)
@@ -104,24 +123,49 @@ class StackedTable:
         data: Dict[str, np.ndarray],
         num_shards: int,
         no_dictionary_columns: Tuple[str, ...] = (),
+        table_config=None,
     ) -> "StackedTable":
-        """Build from column-major data, row-partitioned into num_shards."""
-        from pinot_tpu.segment.builder import _extract_nulls
+        """Build from column-major data, row-partitioned into num_shards.
+
+        table_config.indexing drives index construction (inverted/range
+        bitmaps over the flat padded doc space, data pre-sorted when
+        sorted_column is declared) — the distributed counterpart of
+        segment/builder.py's index creation (SegmentColumnarIndexCreator
+        analog), so the shard_map filter kernels can ride bitmap/doc-range
+        params instead of code scans."""
+        from pinot_tpu.indexes.inverted import InvertedIndex, RangeEncodedIndex
+        from pinot_tpu.segment.builder import MAX_BITMAP_INDEX_CARDINALITY, _extract_nulls
         from pinot_tpu.segment.stats import collect_stats
+
+        idx_cfg = table_config.indexing if table_config is not None else None
 
         names = schema.column_names
         n = len(data[names[0]]) if names else 0
+        # 32-align docs_per_shard: per-device row counts stay multiples of 32
+        # so index bitmap words split cleanly across devices
         D = -(-n // num_shards)  # ceil
+        D = -(-D // 32) * 32
         total = num_shards * D
+
+        # sorted column: physically sort rows (the sorted "index" IS the
+        # order, SortedIndexReader analog)
+        if idx_cfg is not None and idx_cfg.sorted_column and idx_cfg.sorted_column in data and n > 1:
+            order = np.argsort(np.asarray(data[idx_cfg.sorted_column]), kind="stable")
+            if not np.array_equal(order, np.arange(n)):
+                data = {k: np.asarray(v)[order] for k, v in data.items()}
 
         valid = np.zeros(total, dtype=bool)
         valid[:n] = True
 
         columns: Dict[str, StackedColumn] = {}
+        indexes: Dict[str, Dict[str, Any]] = {}
         for f in schema.fields:
             arr, nmask = _extract_nulls(f, data[f.name])
+            no_dict_cfg = tuple(idx_cfg.no_dictionary_columns) if idx_cfg is not None else ()
             use_dict = f.data_type.is_string_like or (
-                f.name not in no_dictionary_columns and f.role in (FieldRole.DIMENSION, FieldRole.DATE_TIME)
+                f.name not in no_dictionary_columns
+                and f.name not in no_dict_cfg
+                and f.role in (FieldRole.DIMENSION, FieldRole.DATE_TIME)
             )
             padded_nulls = None
             if nmask is not None:
@@ -136,6 +180,18 @@ class StackedTable:
                 columns[f.name] = StackedColumn(
                     f.name, f.data_type, dictionary, codes.reshape(num_shards, D), None, padded_nulls, stats
                 )
+                card = dictionary.cardinality
+                if idx_cfg is not None and card <= MAX_BITMAP_INDEX_CARDINALITY:
+                    # padded rows carry code 0 and DO enter the bitmaps;
+                    # every kernel ANDs the valid mask, so they stay invisible
+                    if f.name in idx_cfg.inverted_index_columns:
+                        indexes.setdefault("inverted", {})[f.name] = InvertedIndex.build(
+                            codes.astype(np.int64), card, total
+                        )
+                    if f.name in idx_cfg.range_index_columns:
+                        indexes.setdefault("range", {})[f.name] = RangeEncodedIndex.build(
+                            codes.astype(np.int64), card, total
+                        )
             else:
                 from pinot_tpu.segment.builder import narrow_ints
 
@@ -147,10 +203,14 @@ class StackedTable:
                 columns[f.name] = StackedColumn(
                     f.name, f.data_type, None, None, vals.reshape(num_shards, D), padded_nulls, stats
                 )
-        return StackedTable(schema, columns, valid.reshape(num_shards, D), n)
+        return StackedTable(schema, columns, valid.reshape(num_shards, D), n, indexes=indexes)
 
     @staticmethod
-    def from_segments(segments: List[ImmutableSegment], num_shards: Optional[int] = None) -> "StackedTable":
+    def from_segments(
+        segments: List[ImmutableSegment],
+        num_shards: Optional[int] = None,
+        table_config=None,
+    ) -> "StackedTable":
         """Re-align N immutable segments onto a shared key space.
 
         Dictionary union + code remap per segment (the price Pinot pays per
@@ -194,7 +254,9 @@ class StackedTable:
         no_dict = tuple(
             f.name for f in schema.fields if not segments[0].column(f.name).has_dictionary
         )
-        return StackedTable.build(schema, merged, S, no_dictionary_columns=no_dict)
+        return StackedTable.build(
+            schema, merged, S, no_dictionary_columns=no_dict, table_config=table_config
+        )
 
     # -- device residency ----------------------------------------------
     def to_device(self, mesh=None, axis: str = "seg", columns: Optional[List[str]] = None):

@@ -46,6 +46,12 @@ class QueryEngine:
 
     # -- execution -------------------------------------------------------
     def execute(self, ctx: QueryContext, device=None) -> ResultTable:
+        if ctx.joins:
+            raise NotImplementedError(
+                "JOIN queries require the distributed engine "
+                "(parallel.DistributedEngine routes them to mse.MultiStageEngine); "
+                "the single-node QueryEngine serves single-table queries only"
+            )
         t0 = time.perf_counter()
         state = self.table(ctx.table)
         self._inject_global_ranges(ctx, state)

@@ -88,6 +88,12 @@ class FilterCompiler:
         self.used_columns = set()
         # (column, "sorted"|"range"|"inverted") per index-accelerated predicate
         self.index_uses: List[Tuple[str, str]] = []
+        # Sharded compilation target (_ShardView): (axis_name, ndev,
+        # local_rows) — bitmap params split on the leading device axis and
+        # doc ranges compare against GLOBAL flat doc ids (parallel/engine.py)
+        self.shard_info: Optional[Tuple[str, int, int]] = getattr(segment, "shard_info", None)
+        # param keys whose leading axis is the device axis (in_spec P(axis))
+        self.row_sharded_params: set = set()
 
     def _key(self, suffix: str) -> str:
         k = f"f{self._counter}.{suffix}"
@@ -278,9 +284,17 @@ class FilterCompiler:
         self.params[hi_key] = np.int32(d1)
         self._null_guard(name, has_nulls)
         self.index_uses.append((name, "sorted"))
+        shard_info = self.shard_info
 
         def eval_docrange(cols, params, _lo=lo_key, _hi=hi_key, _name=name, _has=has_nulls):
-            docs = jnp.arange(n, dtype=jnp.int32)
+            if shard_info is not None:
+                axis, _, local_rows = shard_info
+                from jax import lax
+
+                base = lax.axis_index(axis).astype(jnp.int32) * jnp.int32(local_rows)
+                docs = base + jnp.arange(local_rows, dtype=jnp.int32)
+            else:
+                docs = jnp.arange(n, dtype=jnp.int32)
             t = (docs >= params[_lo]) & (docs < params[_hi])
             nulls = cols[_name].get("nulls") if _has else None
             if nulls is not None:
@@ -292,12 +306,22 @@ class FilterCompiler:
     def _emit_bitmap(self, name: str, words: np.ndarray, kind: str, has_nulls: bool, negate: bool):
         n = self.segment.num_docs
         key = self._key("bits")
-        self.params[key] = np.ascontiguousarray(words, dtype=np.uint32)
+        words = np.ascontiguousarray(words, dtype=np.uint32)
+        if self.shard_info is not None:
+            # split words on the device axis: each device ships + unpacks
+            # ONLY its slice (local_rows is 32-aligned by construction)
+            _, ndev, local_rows = self.shard_info
+            assert local_rows % 32 == 0 and words.size == ndev * (local_rows // 32), (
+                words.size, ndev, local_rows,
+            )
+            words = words.reshape(ndev, local_rows // 32)
+            self.row_sharded_params.add(key)
+        self.params[key] = words
         self._null_guard(name, has_nulls)
         self.index_uses.append((name, kind))
 
         def eval_bitmap(cols, params, _key=key, _name=name, _has=has_nulls, _neg=negate):
-            w = params[_key]
+            w = params[_key].reshape(-1)
             bits = ((w[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)) != 0
             t = bits.reshape(-1)[:n]
             if _neg:
@@ -312,24 +336,21 @@ class FilterCompiler:
     def _try_index_paths(self, name, col, lo_code, hi_code, table, has_nulls):
         """Sorted doc-range > range-index > inverted-index, else None (scan)."""
         if lo_code is not None:  # code-range predicate (EQ / RANGE)
-            # 1-D codes only: stacked/sharded views are [S, D] and per-table
-            # sortedness says nothing about per-shard flat order
-            if col.stats.is_sorted and col.codes is not None and np.asarray(col.codes).ndim == 1:
+            if col.stats.is_sorted and col.codes is not None:
                 codes_arr = np.asarray(col.codes)
+                if codes_arr.ndim == 2:
+                    # stacked [S, D]: flat row-major order IS the build input
+                    # order (padding all at the tail) — slice it off so
+                    # searchsorted sees the sorted run; doc ranges are in
+                    # GLOBAL flat coordinates (see _emit_doc_range)
+                    total = getattr(self.segment, "total_docs", None)
+                    if total is None:
+                        return self._try_bitmap_range(name, col, lo_code, hi_code, has_nulls)
+                    codes_arr = codes_arr.reshape(-1)[:total]
                 d0 = int(np.searchsorted(codes_arr, lo_code, side="left"))
                 d1 = int(np.searchsorted(codes_arr, hi_code, side="left")) if hi_code > lo_code else d0
                 return self._emit_doc_range(name, d0, d1, has_nulls)
-            rng_idx = self._col_index("range", name)
-            if rng_idx is not None:
-                return self._emit_bitmap(
-                    name, rng_idx.range_bitmap(lo_code, hi_code), "range", has_nulls, False
-                )
-            inv = self._col_index("inverted", name)
-            if inv is not None and (hi_code - lo_code) <= _INV_MAX_ROWS:
-                ids = np.arange(lo_code, hi_code, dtype=np.int64)
-                words = inv.doc_bitmap(ids) if len(ids) else np.zeros(inv.bitmaps.shape[1], np.uint32)
-                return self._emit_bitmap(name, words, "inverted", has_nulls, False)
-            return None
+            return self._try_bitmap_range(name, col, lo_code, hi_code, has_nulls)
         # table predicate (IN / NOT_IN / NEQ / regex / LIKE)
         inv = self._col_index("inverted", name)
         if inv is None:
@@ -342,6 +363,20 @@ class FilterCompiler:
         if len(neg_ids) <= _INV_MAX_ROWS:
             words = inv.doc_bitmap(neg_ids) if len(neg_ids) else np.zeros(inv.bitmaps.shape[1], np.uint32)
             return self._emit_bitmap(name, words, "inverted", has_nulls, True)
+        return None
+
+    def _try_bitmap_range(self, name, col, lo_code, hi_code, has_nulls):
+        """Range-index / inverted-index resolution for a code-range predicate."""
+        rng_idx = self._col_index("range", name)
+        if rng_idx is not None:
+            return self._emit_bitmap(
+                name, rng_idx.range_bitmap(lo_code, hi_code), "range", has_nulls, False
+            )
+        inv = self._col_index("inverted", name)
+        if inv is not None and (hi_code - lo_code) <= _INV_MAX_ROWS:
+            ids = np.arange(lo_code, hi_code, dtype=np.int64)
+            words = inv.doc_bitmap(ids) if len(ids) else np.zeros(inv.bitmaps.shape[1], np.uint32)
+            return self._emit_bitmap(name, words, "inverted", has_nulls, False)
         return None
 
     # -- raw-value -------------------------------------------------------

@@ -10,11 +10,13 @@ from typing import Any, Dict
 
 from pinot_tpu.indexes.bloom import BloomFilter
 from pinot_tpu.indexes.inverted import InvertedIndex, RangeEncodedIndex
+from pinot_tpu.indexes.startree import StarTreeIndex
 
 _REGISTRY = {
     InvertedIndex.KIND: InvertedIndex,
     RangeEncodedIndex.KIND: RangeEncodedIndex,
     BloomFilter.KIND: BloomFilter,
+    StarTreeIndex.KIND: StarTreeIndex,
 }
 
 

@@ -93,6 +93,12 @@ def execute_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
     """Run one query on one segment; returns (SegmentResult, ExecutionStats)."""
     import jax
 
+    from pinot_tpu.query.startree import try_startree
+
+    star = try_startree(ctx, segment)
+    if star is not None:
+        return star
+
     stats = ExecutionStats(
         num_segments_queried=1,
         num_segments_processed=1,

@@ -1,0 +1,205 @@
+"""Star-tree query routing + execution over collapsed level tables.
+
+Reference parity: Pinot injects the star-tree when a group-by's filter and
+group columns fall inside the tree's dimension split order and every
+aggregation has a matching function-column pair
+(AggregationPlanNode.buildAggregationInfoWithStarTree,
+pinot-core/.../core/plan/AggregationPlanNode.java:109;
+StarTreeFilterOperator traversal, .../core/startree/operator/
+StarTreeFilterOperator.java:90,218; StarTreeAggregationExecutor /
+StarTreeGroupByExecutor, .../core/startree/executor/).
+
+Re-design (see indexes/startree.py): tree traversal becomes level selection —
+pick the smallest prefix level covering the query's dimension set, compile the
+ordinary FilterCompiler against the level facade (parent dictionaries, so the
+result merges with raw-scan segments in one key space), and combine the
+pre-aggregated partial FIELDS per group.  Rows scanned = collapsed level rows,
+the docs-scanned win the reference gets from skipping to aggregated docs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.query import planner
+from pinot_tpu.query.filter import FilterCompiler
+from pinot_tpu.query.functions import for_spec
+from pinot_tpu.query.ir import QueryContext
+from pinot_tpu.query.result import (
+    AggSegmentResult,
+    ExecutionStats,
+    GroupBySegmentResult,
+)
+
+_IDENT = {"count": 0, "sum": 0, "sumsq": 0.0, "min": np.inf, "max": -np.inf}
+
+
+def pick_tree(ctx: QueryContext, segment) -> Optional[Tuple[object, int]]:
+    """(StarTreeIndex, level k) when a tree of this segment can answer ctx."""
+    trees = getattr(segment, "indexes", {}).get("startree", {})
+    if not trees or ctx.joins or not ctx.is_aggregate:
+        return None
+    for g in ctx.group_by:
+        if not g.is_column or g.op == "*":
+            return None
+    group_cols = {g.op for g in ctx.group_by}
+    filter_cols = set(ctx.filter.columns()) if ctx.filter else set()
+    agg_filter_cols = set()
+    for spec in ctx.aggregations:
+        if spec.expr is not None and not spec.expr.is_column:
+            return None
+        if spec.filter is not None:
+            agg_filter_cols |= set(spec.filter.columns())
+    dims_used = group_cols | filter_cols | agg_filter_cols
+    if "*" in dims_used:
+        return None
+
+    best: Optional[Tuple[object, int]] = None
+    for st in trees.values():
+        k = st.level_for(dims_used)
+        if k is None:
+            continue
+        ok = True
+        for spec in ctx.aggregations:
+            col = spec.expr.op if spec.expr is not None else "*"
+            if col != "*" and segment.column(col).nulls is not None:
+                ok = False  # star count fields assume null-free metrics
+                break
+            if not st.has_fields(spec.function, col):
+                ok = False
+                break
+        if not ok:
+            continue
+        if best is None or st.levels[k].num_rows < best[0].levels[best[1]].num_rows:
+            best = (st, k)
+    return best
+
+
+def execute_star(ctx: QueryContext, segment, st, k):
+    """Run ctx against star level k; returns (SegmentResult, ExecutionStats).
+
+    Returns None when a runtime limit (composite key overflow) forces the
+    regular scan path after all."""
+    lvl = st.levels[k]
+    view = lvl.facade(segment)
+    stats = ExecutionStats(
+        num_segments_queried=1,
+        num_segments_processed=1,
+        num_docs_scanned=lvl.num_rows,
+        total_docs=segment.num_docs,
+    )
+
+    fc = FilterCompiler(view, null_handling=False)
+    filter_fn = fc.compile(ctx.filter)
+    agg_specs = list(ctx.aggregations)
+    agg_filter_fns = [
+        fc.compile(s.filter) if s.filter is not None else None for s in agg_specs
+    ]
+
+    # level tables are collapsed-small: evaluate the compiled mask closures
+    # eagerly (jnp ops accept numpy inputs) and finish host-side
+    cols: Dict[str, Dict[str, np.ndarray]] = {}
+    for name, c in view.columns.items():
+        entry: Dict[str, np.ndarray] = {}
+        if c.codes is not None:
+            entry["codes"] = c.codes
+            dv = c.dictionary.device_values() if c.dictionary else None
+            if dv is not None:
+                entry["dict"] = dv
+        if c.values is not None:
+            entry["values"] = c.values
+        cols[name] = entry
+    tmask = np.asarray(filter_fn(cols, fc.params)[0])
+    agg_masks = [
+        tmask if fn is None else (tmask & np.asarray(fn(cols, fc.params)[0]))
+        for fn in agg_filter_fns
+    ]
+
+    counts = lvl.fields[("*", "count")]
+    aggs = [for_spec(s) for s in agg_specs]
+    stats.add_index_uses(fc.index_uses)
+    stats.add_index_uses([("/".join(st.split_order[:k]) or "*", "startree")])
+
+    def field_source(spec, kind) -> np.ndarray:
+        if kind == "count":
+            return counts
+        return lvl.fields[(spec.expr.op, kind)]
+
+    if not ctx.group_by:
+        partials: List[Dict[str, np.ndarray]] = []
+        for spec, fn, m in zip(agg_specs, aggs, agg_masks):
+            p: Dict[str, np.ndarray] = {}
+            for fname, kind in fn.field_kinds.items():
+                src = field_source(spec, kind)
+                sel = src[m]
+                if kind in ("count", "sum", "sumsq"):
+                    p[fname] = sel.sum() if len(sel) else np.asarray(_IDENT[kind], src.dtype)
+                elif kind == "min":
+                    p[fname] = sel.min() if len(sel) else np.asarray(np.inf)
+                else:
+                    p[fname] = sel.max() if len(sel) else np.asarray(-np.inf)
+            partials.append(p)
+        return AggSegmentResult(partials=partials), stats
+
+    # group-by: pack level dim codes into composite keys (same packing as the
+    # raw-scan paths so decoded keys land in the same space)
+    group_dims = [planner._group_dim(g, view, False) for g in ctx.group_by]
+    packed = np.zeros(lvl.num_rows, dtype=np.int64)
+    scale = 1
+    for gd in reversed(group_dims):
+        if scale > (1 << 62) // max(1, gd.cardinality):
+            return None  # >63-bit composite key: let the scan path handle it
+        c = view.column(gd.name)
+        code = (
+            c.codes.astype(np.int64)
+            if gd.kind == "dict"
+            else c.values.astype(np.int64) - gd.base
+        )
+        packed += code * scale
+        scale *= gd.cardinality
+
+    sel = np.nonzero(tmask)[0]
+    uniq, inverse_sel = np.unique(packed[sel], return_inverse=True)
+    if len(uniq) > ctx.num_groups_limit:
+        keep = inverse_sel < ctx.num_groups_limit
+        sel = sel[keep]
+        inverse_sel = inverse_sel[keep]
+        uniq = uniq[: ctx.num_groups_limit]
+    n_groups = len(uniq)
+    keys = planner.decode_packed_keys(group_dims, uniq)
+
+    partials = []
+    for spec, fn, m in zip(agg_specs, aggs, agg_masks):
+        msel = m[sel]
+        p: Dict[str, np.ndarray] = {}
+        for fname, kind in fn.field_kinds.items():
+            src = field_source(spec, kind)[sel]
+            if kind in ("count", "sum") and np.issubdtype(src.dtype, np.integer):
+                acc = np.zeros(n_groups, dtype=np.int64)
+                np.add.at(acc, inverse_sel[msel], src[msel])
+            elif kind in ("count", "sum", "sumsq"):
+                acc = np.bincount(
+                    inverse_sel[msel], weights=src[msel].astype(np.float64), minlength=n_groups
+                )
+            elif kind == "min":
+                acc = np.full(n_groups, np.inf)
+                np.minimum.at(acc, inverse_sel[msel], src[msel].astype(np.float64))
+            else:
+                acc = np.full(n_groups, -np.inf)
+                np.maximum.at(acc, inverse_sel[msel], src[msel].astype(np.float64))
+            p[fname] = acc
+        partials.append(p)
+    stats.num_groups = n_groups
+    return GroupBySegmentResult(keys=keys, partials=partials, dense=None), stats
+
+
+def try_startree(ctx: QueryContext, segment):
+    """Entry point for executor: result when a star-tree served the query."""
+    opt = ctx.options.get("useStarTree", True)
+    if (not opt) or (isinstance(opt, str) and opt.lower() in ("false", "0")):
+        return None
+    pick = pick_tree(ctx, segment)
+    if pick is None:
+        return None
+    return execute_star(ctx, segment, pick[0], pick[1])

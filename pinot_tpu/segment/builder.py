@@ -151,6 +151,20 @@ def build_segment(
             uniq = columns[f.name].dictionary.values if use_dict else np.unique(arr)
             indexes.setdefault("bloom", {})[f.name] = BloomFilter.build(list(uniq))
 
+    # star-tree indexes: pre-aggregated prefix-level tensors (indexes/startree.py)
+    for i, st_cfg in enumerate(idx_cfg.star_tree_index_configs):
+        from pinot_tpu.indexes.startree import StarTreeIndex
+
+        st = StarTreeIndex.build(
+            columns,
+            num_docs,
+            st_cfg.get("dimensionsSplitOrder", []),
+            st_cfg.get("functionColumnPairs", []),
+            min_collapse=float(st_cfg.get("minCollapse", 1.1)),
+        )
+        if st is not None:
+            indexes.setdefault("startree", {})[f"st{i}"] = st
+
     # partition metadata for partition-pinned routing
     if cfg.partition_column and cfg.partition_column in columns and cfg.num_partitions:
         col = columns[cfg.partition_column]

@@ -1,0 +1,210 @@
+"""Star-tree index: build, route, answer from collapsed levels, persist.
+
+Every query runs twice — star-tree enabled vs SET useStarTree=false — and
+both must match the sqlite golden answer; the star run must scan (far) fewer
+docs and report the startree index use.  (StarTreeV2 / StarTreeFilterOperator
+analog coverage, SURVEY.md section 2.1 row "Star-tree index".)"""
+import numpy as np
+import pytest
+
+from tests.golden import assert_same_rows, sqlite_from_data
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.config import IndexingConfig, TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+N = 8000
+YEARS = list(range(1992, 1999))
+REGIONS = ["AMERICA", "ASIA", "EUROPE", "AFRICA"]
+CATS = ["c%d" % i for i in range(12)]
+
+
+def _data(rng):
+    return {
+        "d_year": rng.choice(YEARS, N).astype(np.int32),
+        "region": rng.choice(REGIONS, N).astype(object),
+        "category": rng.choice(CATS, N).astype(object),
+        "revenue": rng.integers(0, 1_000_000, N),
+        "quantity": rng.integers(1, 50, N).astype(np.int32),
+    }
+
+
+def _schema():
+    return Schema(
+        "ssb",
+        [
+            FieldSpec("d_year", DataType.INT),
+            FieldSpec("region", DataType.STRING),
+            FieldSpec("category", DataType.STRING),
+            FieldSpec("revenue", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("quantity", DataType.INT, role=FieldRole.METRIC),
+        ],
+    )
+
+
+ST_CFG = {
+    "dimensionsSplitOrder": ["d_year", "region", "category"],
+    "functionColumnPairs": [
+        "COUNT__*",
+        "SUM__revenue",
+        "AVG__quantity",
+        "MIN__revenue",
+        "MAX__revenue",
+    ],
+    "maxLeafRecords": 10000,
+}
+
+
+@pytest.fixture(scope="module")
+def env():
+    rng = np.random.default_rng(7)
+    data = _data(rng)
+    schema = _schema()
+    cfg = TableConfig(
+        name="ssb", indexing=IndexingConfig(star_tree_index_configs=[ST_CFG])
+    )
+    seg = build_segment(schema, data, "seg0", table_config=cfg)
+    eng = QueryEngine()
+    eng.register_table(schema, cfg)
+    eng.add_segment("ssb", seg)
+    conn = sqlite_from_data("ssb", data)
+    return eng, conn, seg
+
+
+def _check(env, sql, expect_star=True, sql_lite=None):
+    eng, conn, seg = env
+    res_star = eng.query(sql)
+    res_scan = eng.query("SET useStarTree=false; " + sql)
+    expected = conn.execute(sql_lite or sql).fetchall()
+    assert_same_rows(res_star.rows, expected)
+    assert_same_rows(res_scan.rows, expected)
+    kinds = {k for _, k in res_star.stats.filter_index_uses}
+    if expect_star:
+        assert "startree" in kinds, res_star.stats.filter_index_uses
+        assert res_star.stats.num_docs_scanned < res_scan.stats.num_docs_scanned
+    else:
+        assert "startree" not in kinds
+    return res_star
+
+
+def test_tree_built(env):
+    _, _, seg = env
+    st = seg.indexes["startree"]["st0"]
+    assert st.split_order == ["d_year", "region", "category"]
+    # finest level collapses 8000 rows into <= |years|*|regions|*|cats| combos
+    assert st.levels[3].num_rows <= len(YEARS) * len(REGIONS) * len(CATS)
+    # coarser prefix levels shrink monotonically down to the 1-row total
+    assert st.levels[2].num_rows <= st.levels[3].num_rows
+    assert st.levels[0].num_rows == 1
+
+
+def test_groupby_sum(env):
+    _check(env, "SELECT d_year, SUM(revenue) FROM ssb GROUP BY d_year")
+
+
+def test_groupby_filtered(env):
+    _check(
+        env,
+        "SELECT d_year, SUM(revenue) FROM ssb WHERE region = 'ASIA' GROUP BY d_year",
+    )
+
+
+def test_groupby_multi_dim_all_aggs(env):
+    _check(
+        env,
+        "SELECT d_year, region, COUNT(*), SUM(revenue), AVG(quantity), "
+        "MIN(revenue), MAX(revenue) FROM ssb GROUP BY d_year, region LIMIT 100",
+    )
+
+
+def test_aggregation_only(env):
+    res = _check(env, "SELECT SUM(revenue), COUNT(*) FROM ssb")
+    # no dims used -> level 0: exactly one pre-aggregated row scanned
+    assert res.stats.num_docs_scanned == 1
+
+
+def test_level_selection(env):
+    eng, conn, seg = env
+    st = seg.indexes["startree"]["st0"]
+    res = eng.query("SELECT d_year, COUNT(*) FROM ssb GROUP BY d_year")
+    assert res.stats.num_docs_scanned == st.levels[1].num_rows
+    res2 = eng.query(
+        "SELECT category, COUNT(*) FROM ssb GROUP BY category"
+    )  # category is last in split order -> needs the finest level
+    assert res2.stats.num_docs_scanned == st.levels[3].num_rows
+
+
+def test_range_filter_on_dim(env):
+    _check(
+        env,
+        "SELECT region, SUM(revenue) FROM ssb WHERE d_year > 1994 GROUP BY region",
+    )
+
+
+def test_having_order_limit(env):
+    _check(
+        env,
+        "SELECT region, SUM(revenue) AS r FROM ssb GROUP BY region "
+        "HAVING r > 0 ORDER BY r DESC LIMIT 3",
+    )
+
+
+def test_not_applicable_non_dim_filter(env):
+    # filter on a metric column: tree cannot answer; scan path must serve it
+    _check(
+        env,
+        "SELECT d_year, COUNT(*) FROM ssb WHERE quantity > 25 GROUP BY d_year",
+        expect_star=False,
+    )
+
+
+def test_sum_rides_avg_pair_fields(env):
+    # field-level storage is strictly more capable than Pinot's pair-level:
+    # AVG__quantity stored (sum, count), which is exactly SUM's partial too
+    _check(env, "SELECT d_year, SUM(quantity) FROM ssb GROUP BY d_year")
+
+
+def test_not_applicable_unpaired_agg(env):
+    # MIN(quantity) has no stored (quantity, min) field -> scan path serves it
+    _check(
+        env,
+        "SELECT d_year, MIN(quantity) FROM ssb GROUP BY d_year",
+        expect_star=False,
+    )
+
+
+def test_save_load_roundtrip(env, tmp_path):
+    eng, conn, seg = env
+    from pinot_tpu.segment.segment import ImmutableSegment
+
+    path = str(tmp_path / "seg_star")
+    seg.save(path)
+    seg2 = ImmutableSegment.load(path)
+    assert "startree" in seg2.indexes
+    eng2 = QueryEngine()
+    eng2.register_table(_schema(), TableConfig(name="ssb"))
+    eng2.add_segment("ssb", seg2)
+    sql = "SELECT d_year, region, SUM(revenue) FROM ssb GROUP BY d_year, region LIMIT 100"
+    res = eng2.query(sql)
+    assert_same_rows(res.rows, conn.execute(sql).fetchall())
+    assert {k for _, k in res.stats.filter_index_uses} >= {"startree"}
+
+
+def test_mixed_segments_merge(env):
+    """One segment with a tree + one without must merge in one key space."""
+    eng, conn, seg = env
+    rng = np.random.default_rng(8)
+    data2 = _data(rng)
+    seg2 = build_segment(_schema(), data2, "seg1")  # no star tree
+    eng2 = QueryEngine()
+    eng2.register_table(_schema(), TableConfig(name="ssb"))
+    eng2.add_segment("ssb", seg)
+    eng2.add_segment("ssb", seg2)
+
+    import sqlite3
+
+    conn2 = sqlite_from_data("ssb", {k: np.concatenate([np.asarray(_data(np.random.default_rng(7))[k]), np.asarray(data2[k])]) for k in data2})
+    sql = "SELECT d_year, SUM(revenue), COUNT(*) FROM ssb WHERE region != 'AFRICA' GROUP BY d_year"
+    res = eng2.query(sql)
+    assert_same_rows(res.rows, conn2.execute(sql).fetchall())

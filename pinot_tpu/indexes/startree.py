@@ -42,6 +42,31 @@ _ADDITIVE = ("sum", "sumsq")
 _MINMAX = ("min", "max")
 
 
+def scatter_combine(kind: str, inverse: np.ndarray, vals: np.ndarray, n_groups: int) -> np.ndarray:
+    """One (count|sum|sumsq|min|max) scatter-aggregate into n_groups slots —
+    the single combine rule shared by the finest-level build, the coarser-level
+    rollup, and the star-served group-by path.  Additive integer kinds
+    accumulate exactly in int64; float kinds use bincount; min/max use ufunc
+    scatter.  `vals` is taken as-is (callers square before passing sumsq of
+    raw rows; partials re-combine without squaring)."""
+    vals = np.asarray(vals)
+    if kind in ("count", "sum", "sumsq"):
+        if np.issubdtype(vals.dtype, np.integer) and kind != "sumsq":
+            acc = np.zeros(n_groups, dtype=np.int64)
+            np.add.at(acc, inverse, vals.astype(np.int64, copy=False))
+            return acc
+        return np.bincount(inverse, weights=vals.astype(np.float64, copy=False), minlength=n_groups)
+    if kind == "min":
+        acc = np.full(n_groups, np.inf)
+        np.minimum.at(acc, inverse, vals.astype(np.float64, copy=False))
+        return acc
+    if kind == "max":
+        acc = np.full(n_groups, -np.inf)
+        np.maximum.at(acc, inverse, vals.astype(np.float64, copy=False))
+        return acc
+    raise ValueError(f"unknown star-tree field kind {kind!r}")
+
+
 def _parse_pairs(pairs: List[Any]) -> List[Tuple[str, str]]:
     """functionColumnPairs: "SUM__lo_revenue" strings or [func, col] lists."""
     out = []
@@ -130,23 +155,8 @@ class StarTreeIndex:
         fields: Dict[Tuple[str, str], np.ndarray] = {}
         fields[("*", "count")] = np.bincount(inverse, minlength=n_g).astype(np.int64)
         for (col, kind), vals in need.items():
-            if kind == "sum":
-                if np.issubdtype(vals.dtype, np.integer):
-                    acc = np.zeros(n_g, dtype=np.int64)
-                    np.add.at(acc, inverse, vals.astype(np.int64))
-                else:
-                    acc = np.bincount(inverse, weights=vals.astype(np.float64), minlength=n_g)
-            elif kind == "sumsq":
-                acc = np.bincount(
-                    inverse, weights=vals.astype(np.float64) ** 2, minlength=n_g
-                )
-            elif kind == "min":
-                acc = np.full(n_g, np.inf)
-                np.minimum.at(acc, inverse, vals.astype(np.float64))
-            else:  # max
-                acc = np.full(n_g, -np.inf)
-                np.maximum.at(acc, inverse, vals.astype(np.float64))
-            fields[(col, kind)] = acc
+            src = vals.astype(np.float64) ** 2 if kind == "sumsq" else vals
+            fields[(col, kind)] = scatter_combine(kind, inverse, src, n_g)
 
         K = len(split_order)
         levels: Dict[int, StarLevel] = {
@@ -165,18 +175,7 @@ class StarTreeIndex:
             m = len(combos)
             f2: Dict[Tuple[str, str], np.ndarray] = {}
             for (col, kind), arr in finer.fields.items():
-                if kind in ("count", "sum") and np.issubdtype(arr.dtype, np.integer):
-                    acc = np.zeros(m, dtype=np.int64)
-                    np.add.at(acc, inv2, arr)
-                elif kind in ("count", "sum", "sumsq"):
-                    acc = np.bincount(inv2, weights=arr, minlength=m)
-                elif kind == "min":
-                    acc = np.full(m, np.inf)
-                    np.minimum.at(acc, inv2, arr)
-                else:
-                    acc = np.full(m, -np.inf)
-                    np.maximum.at(acc, inv2, arr)
-                f2[(col, kind)] = acc
+                f2[(col, kind)] = scatter_combine(kind, inv2, arr, m)
             levels[k] = StarLevel(
                 num_rows=m,
                 dims={d: combos[:, i].copy() for i, d in enumerate(split_order[:k])},

@@ -25,6 +25,17 @@ class TableState:
     schema: Schema
     config: TableConfig
     segments: List[ImmutableSegment] = field(default_factory=list)
+    # realtime tables: RealtimeTableDataManager owning sealed + consuming
+    # segments (realtime/manager.py); None for offline tables
+    realtime: Optional[object] = None
+
+    def query_segments(self) -> List[ImmutableSegment]:
+        """The segment list a query against this table scans: offline
+        segments plus the realtime view (sealed + consuming snapshots)."""
+        segs = list(self.segments)
+        if self.realtime is not None:
+            segs.extend(self.realtime.query_segments())
+        return segs
 
 
 class QueryEngine:
@@ -54,10 +65,11 @@ class QueryEngine:
             )
         t0 = time.perf_counter()
         state = self.table(ctx.table)
-        self._inject_global_ranges(ctx, state)
+        segments = state.query_segments()
+        self._inject_global_ranges(ctx, state, segments)
         stats = ExecutionStats()
         results = []
-        for seg in state.segments:
+        for seg in segments:
             stats.num_segments_queried += 1
             stats.total_docs += seg.num_docs
             if executor.prune_segment(ctx, seg):
@@ -72,8 +84,12 @@ class QueryEngine:
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         return out
 
+    def attach_realtime(self, table: str, manager) -> None:
+        """Bind a RealtimeTableDataManager to a registered table."""
+        self.table(table).realtime = manager
+
     @staticmethod
-    def _inject_global_ranges(ctx: QueryContext, state: TableState) -> None:
+    def _inject_global_ranges(ctx: QueryContext, state: TableState, segments=None) -> None:
         """Table-global facts per sketch-aggregated column, injected as ctx
         options so every segment binds identically:
           __range__<col>  - global [min, max]: histogram bin edges must be
@@ -83,6 +99,8 @@ class QueryEngine:
                             code-indexed partials must not merge"""
         from pinot_tpu.query.functions import for_spec
 
+        if segments is None:
+            segments = state.query_segments()
         for spec in ctx.aggregations:
             if spec.expr is None or not spec.expr.is_column:
                 continue
@@ -94,7 +112,7 @@ class QueryEngine:
                 continue
             mins, maxs = [], []
             fps = set()
-            for seg in state.segments:
+            for seg in segments:
                 if col not in seg.columns:
                     continue
                 c = seg.column(col)

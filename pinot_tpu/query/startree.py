@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pinot_tpu.indexes.startree import scatter_combine
 from pinot_tpu.query import planner
 from pinot_tpu.query.filter import FilterCompiler
 from pinot_tpu.query.functions import for_spec
@@ -175,20 +176,7 @@ def execute_star(ctx: QueryContext, segment, st, k):
         p: Dict[str, np.ndarray] = {}
         for fname, kind in fn.field_kinds.items():
             src = field_source(spec, kind)[sel]
-            if kind in ("count", "sum") and np.issubdtype(src.dtype, np.integer):
-                acc = np.zeros(n_groups, dtype=np.int64)
-                np.add.at(acc, inverse_sel[msel], src[msel])
-            elif kind in ("count", "sum", "sumsq"):
-                acc = np.bincount(
-                    inverse_sel[msel], weights=src[msel].astype(np.float64), minlength=n_groups
-                )
-            elif kind == "min":
-                acc = np.full(n_groups, np.inf)
-                np.minimum.at(acc, inverse_sel[msel], src[msel].astype(np.float64))
-            else:
-                acc = np.full(n_groups, -np.inf)
-                np.maximum.at(acc, inverse_sel[msel], src[msel].astype(np.float64))
-            p[fname] = acc
+            p[fname] = scatter_combine(kind, inverse_sel[msel], src[msel], n_groups)
         partials.append(p)
     stats.num_groups = n_groups
     return GroupBySegmentResult(keys=keys, partials=partials, dense=None), stats

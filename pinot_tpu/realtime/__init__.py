@@ -1,0 +1,33 @@
+"""Realtime ingestion: stream SPI, mutable segments, consume/seal/swap.
+
+Reference parity map (SURVEY.md §3.3):
+  stream.py   - pinot-spi/.../spi/stream/ (StreamConsumerFactory,
+                PartitionGroupConsumer, MessageBatch, StreamPartitionMsgOffset)
+  mutable.py  - pinot-segment-local/.../indexsegment/mutable/MutableSegmentImpl.java
+  manager.py  - pinot-core/.../data/manager/realtime/RealtimeSegmentDataManager.java
+                (consumeLoop :470, processStreamEvents :591, commitSegment :971)
+                + RealtimeTableDataManager.java:97
+"""
+from pinot_tpu.realtime.stream import (
+    FileStream,
+    InMemoryStream,
+    MessageBatch,
+    StreamMessage,
+    make_consumer,
+)
+from pinot_tpu.realtime.mutable import MutableSegment
+from pinot_tpu.realtime.manager import (
+    RealtimeSegmentDataManager,
+    RealtimeTableDataManager,
+)
+
+__all__ = [
+    "FileStream",
+    "InMemoryStream",
+    "MessageBatch",
+    "StreamMessage",
+    "make_consumer",
+    "MutableSegment",
+    "RealtimeSegmentDataManager",
+    "RealtimeTableDataManager",
+]

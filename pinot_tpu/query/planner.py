@@ -122,7 +122,7 @@ def _sig_value(v):
 def _segment_signature(
     segment: ImmutableSegment, needed: List[str], sketch_cols: frozenset = frozenset()
 ) -> Tuple:
-    sig = [segment.num_docs]
+    sig = [segment.num_docs, segment.valid_docs is not None]
     for name in sorted(needed):
         c = segment.column(name)
         # Raw columns include min/max: the kernel bakes rawint group-dim
@@ -489,6 +489,20 @@ def _build_plan(
     null_handling = ctx.null_handling
     fc = FilterCompiler(segment, null_handling)
     filter_fn = fc.compile(ctx.filter)
+
+    # Upsert validDocIds: rows replaced by a newer row elsewhere are ANDed
+    # out of EVERY filter (the reference's validDocIds bitmap in
+    # FilterPlanNode).  The mask ships as a param so invalidations between
+    # queries apply without recompiling; presence is part of the plan-cache
+    # signature (_segment_signature) since the kernel must consume it.
+    if segment.valid_docs is not None:
+        fc.params["__valid__"] = np.asarray(segment.valid_docs, dtype=bool)
+        base_filter_fn = filter_fn
+
+        def filter_fn(cols, params):
+            t, nl = base_filter_fn(cols, params)
+            v = params["__valid__"]
+            return t & v, (nl & v if nl is not None else None)
 
     agg_specs = list(ctx.aggregations)
     aggs = bind_aggs(agg_specs, segment, ctx)

@@ -187,6 +187,10 @@ def try_startree(ctx: QueryContext, segment):
     opt = ctx.options.get("useStarTree", True)
     if (not opt) or (isinstance(opt, str) and opt.lower() in ("false", "0")):
         return None
+    # upsert segments: pre-aggregated levels can't honor per-row validDocIds
+    # (the reference likewise excludes star-trees from upsert tables)
+    if getattr(segment, "valid_docs", None) is not None:
+        return None
     pick = pick_tree(ctx, segment)
     if pick is None:
         return None

@@ -71,6 +71,9 @@ class RealtimeSegmentDataManager:
             batches += 1
             sealed = False
             for msg in batch.messages:
+                if not self.table._should_index(self, msg):
+                    self.offset = msg.offset
+                    continue
                 doc_id = self.mutable.index(msg.value)
                 self.table._on_indexed(self, msg, doc_id)
                 self.offset = msg.offset
@@ -160,9 +163,6 @@ class RealtimeTableDataManager:
         self.managers: Dict[int, RealtimeSegmentDataManager] = {}
         self._checkpoint = self._load_checkpoint()
         self._lock = threading.Lock()
-        # upsert/dedup hooks are installed by cluster/engine layers (round
-        # task #2); default no-ops keep the consume loop branch-free here.
-        self.upsert = None
         for p in range(num_partitions):
             self._recover_partition(p)
             cp = self._checkpoint.get(str(p), {"offset": 0, "seq": 0})
@@ -170,6 +170,23 @@ class RealtimeTableDataManager:
             self.managers[p] = RealtimeSegmentDataManager(
                 self, p, consumer, start_offset=cp["offset"], seq=cp["seq"]
             )
+        # upsert / dedup metadata (realtime/upsert.py), bootstrapped by
+        # replaying recovered sealed segments in (partition, seq) order
+        self.upsert = None
+        self.dedup = None
+        recovered = [s for p in range(num_partitions) for s in self.sealed[p]]
+        if config.upsert is not None and config.upsert.mode != "NONE":
+            from pinot_tpu.realtime.upsert import PartitionUpsertMetadataManager
+
+            self.upsert = PartitionUpsertMetadataManager(schema, config)
+            self.upsert.bootstrap(recovered)
+            for mgr in self.managers.values():
+                self.upsert.track_consuming(mgr.mutable.name)
+        if config.dedup is not None and config.dedup.enabled:
+            from pinot_tpu.realtime.upsert import PartitionDedupMetadataManager
+
+            self.dedup = PartitionDedupMetadataManager(schema, config)
+            self.dedup.bootstrap(recovered)
 
     # -- durability ------------------------------------------------------
     def segment_dir(self, name: str) -> str:
@@ -214,6 +231,11 @@ class RealtimeTableDataManager:
         if self.upsert is not None:
             self.upsert.on_seal(self.managers.get(partition), sealed)
 
+    def _should_index(self, mgr: RealtimeSegmentDataManager, msg) -> bool:
+        if self.dedup is not None:
+            return self.dedup.should_index(mgr, msg)
+        return True
+
     def _on_indexed(self, mgr: RealtimeSegmentDataManager, msg, doc_id: int) -> None:
         if self.upsert is not None:
             self.upsert.on_indexed(mgr, msg, doc_id)
@@ -243,7 +265,10 @@ class RealtimeTableDataManager:
             out.extend(self.sealed[p])
             mgr = self.managers.get(p)
             if mgr is not None and mgr.mutable.num_docs > 0:
-                out.append(mgr.mutable.snapshot())
+                snap = mgr.mutable.snapshot()
+                if self.upsert is not None:
+                    self.upsert.attach_snapshot_mask(snap, mgr.mutable.name)
+                out.append(snap)
         return out
 
     @property

@@ -1,0 +1,162 @@
+"""Upsert + dedup metadata: PK -> latest location, validDocIds bitmasks.
+
+Reference parity: pinot-segment-local ConcurrentMapPartitionUpsertMetadataManager
+(addOrReplaceSegment / addRecord :71-115 — PK hash map holding the winning
+(segment, docId, comparisonValue); losing rows cleared from their segment's
+validDocIds bitmap) and PartitionDedupMetadataManager (drop-duplicate-PK).
+
+Re-design: validDocIds is a host numpy bool mask per segment, shipped to the
+device as a filter param (query/planner.py "__valid__") and ANDed into every
+predicate — the TPU form of the reference's MutableRoaringBitmap intersected
+in FilterPlanNode.  Comparison defaults to the table's time column; later
+arrival wins ties (>=), matching the reference.  On restart the map is
+bootstrapped by replaying sealed segments in sequence order
+(addOrReplaceSegment's rebuild path) — no separate snapshot file needed.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.segment.segment import ImmutableSegment
+from pinot_tpu.spi.config import TableConfig
+from pinot_tpu.spi.schema import Schema
+
+
+class _Location:
+    __slots__ = ("segment", "doc", "cmp")
+
+    def __init__(self, segment: str, doc: int, cmp: Any):
+        self.segment = segment
+        self.doc = doc
+        self.cmp = cmp
+
+
+class PartitionUpsertMetadataManager:
+    """FULL upsert: latest row per primary key wins; older rows are masked
+    out of their segment's validDocIds."""
+
+    def __init__(self, schema: Schema, config: TableConfig):
+        if not schema.primary_key_columns:
+            raise ValueError(f"upsert table {config.name} needs primaryKeyColumns in the schema")
+        self.schema = schema
+        self.config = config
+        self.pk_cols = list(schema.primary_key_columns)
+        cc = (config.upsert.comparison_column if config.upsert else None) or config.segments.time_column
+        if not cc:
+            raise ValueError(
+                "upsert requires a comparison column (upsertConfig.comparisonColumn or the table time column)"
+            )
+        self.cmp_col = cc
+        # pk tuple -> winning location; valid masks by segment name.
+        self.pk_map: Dict[Tuple, _Location] = {}
+        self.valid: Dict[str, Any] = {}  # list[bool] (consuming) | np.ndarray (sealed)
+
+    # -- helpers ---------------------------------------------------------
+    def _pk_of(self, row: Dict[str, Any]) -> Tuple:
+        return tuple(row.get(c) for c in self.pk_cols)
+
+    def _resolve(self, pk: Tuple, cand: _Location) -> None:
+        """addRecord: candidate vs incumbent; later arrival wins ties."""
+        cur = self.pk_map.get(pk)
+        if cur is None:
+            self.pk_map[pk] = cand
+            return
+        if cand.cmp >= cur.cmp:
+            self._invalidate(cur)
+            self.pk_map[pk] = cand
+        else:
+            self._invalidate(cand)
+
+    def _invalidate(self, loc: _Location) -> None:
+        mask = self.valid.get(loc.segment)
+        if mask is not None:
+            mask[loc.doc] = False
+
+    # -- consume-loop hooks (RealtimeTableDataManager calls these) -------
+    def track_consuming(self, name: str) -> None:
+        self.valid.setdefault(name, [])
+
+    def on_indexed(self, mgr, msg, doc_id: int) -> None:
+        name = mgr.mutable.name
+        self.track_consuming(name)
+        self.valid[name].append(True)
+        row = msg.value
+        cmp = row.get(self.cmp_col)
+        self._resolve(self._pk_of(row), _Location(name, doc_id, cmp))
+
+    def on_seal(self, mgr, sealed: ImmutableSegment) -> None:
+        """Freeze the consuming mask into the sealed segment, remapping
+        through the builder's sort permutation when the build reordered rows."""
+        name = sealed.name
+        mask = np.asarray(self.valid.get(name, []), dtype=bool)
+        if len(mask) != sealed.num_docs:
+            mask = np.ones(sealed.num_docs, dtype=bool)
+        order = sealed.sort_order
+        if order is not None:
+            mask = mask[order]  # new position p holds input row order[p]
+            inverse = np.empty_like(order)
+            inverse[order] = np.arange(len(order))
+            for loc in self.pk_map.values():
+                if loc.segment == name:
+                    loc.doc = int(inverse[loc.doc])
+        self.valid[name] = mask
+        sealed.valid_docs = mask  # shared reference: later invalidations apply
+
+    def on_rolled(self, mgr) -> None:
+        self.track_consuming(mgr.mutable.name)
+
+    # -- query-time ------------------------------------------------------
+    def attach_snapshot_mask(self, snapshot: ImmutableSegment, name: str) -> None:
+        """Consuming snapshots get a frozen copy of the live mask (the list
+        keeps growing; the snapshot covers a row-count prefix)."""
+        mask = self.valid.get(name)
+        if mask is None:
+            return
+        snapshot.valid_docs = np.asarray(mask[: snapshot.num_docs], dtype=bool)
+
+    # -- restart ---------------------------------------------------------
+    def bootstrap(self, sealed_in_order: List[ImmutableSegment]) -> None:
+        """Rebuild pk_map + validDocIds by replaying sealed segments in
+        sequence order (the reference's addOrReplaceSegment path)."""
+        for seg in sealed_in_order:
+            n = seg.num_docs
+            self.valid[seg.name] = np.ones(n, dtype=bool)
+            seg.valid_docs = self.valid[seg.name]
+            pk_vals = [seg.column(c).decoded() for c in self.pk_cols]
+            cmp_vals = seg.column(self.cmp_col).decoded()
+            for doc in range(n):
+                pk = tuple(v[doc].item() if isinstance(v[doc], np.generic) else v[doc] for v in pk_vals)
+                cmp = cmp_vals[doc]
+                cmp = cmp.item() if isinstance(cmp, np.generic) else cmp
+                self._resolve(pk, _Location(seg.name, doc, cmp))
+
+
+class PartitionDedupMetadataManager:
+    """Dedup: the FIRST row per primary key is kept; later duplicates are
+    dropped before indexing (PartitionDedupMetadataManager analog)."""
+
+    def __init__(self, schema: Schema, config: TableConfig):
+        if not schema.primary_key_columns:
+            raise ValueError(f"dedup table {config.name} needs primaryKeyColumns in the schema")
+        self.pk_cols = list(schema.primary_key_columns)
+        self.seen: set = set()
+
+    def _pk_of(self, row: Dict[str, Any]) -> Tuple:
+        return tuple(row.get(c) for c in self.pk_cols)
+
+    def should_index(self, mgr, msg) -> bool:
+        pk = self._pk_of(msg.value)
+        if pk in self.seen:
+            return False
+        self.seen.add(pk)
+        return True
+
+    def bootstrap(self, sealed_in_order: List[ImmutableSegment]) -> None:
+        for seg in sealed_in_order:
+            pk_vals = [seg.column(c).decoded() for c in self.pk_cols]
+            for doc in range(seg.num_docs):
+                self.seen.add(
+                    tuple(v[doc].item() if isinstance(v[doc], np.generic) else v[doc] for v in pk_vals)
+                )

@@ -80,6 +80,11 @@ class ImmutableSegment:
         self.indexes: Dict[str, Dict[str, Any]] = indexes or {}
         self.creation_time_ms = creation_time_ms
         self.time_range = time_range  # (min, max) of the table's time column
+        # upsert hooks: validDocIds bitmask (bool[num_docs], False = replaced
+        # by a newer row elsewhere) and the build-time sort permutation
+        # (new position -> input row) used to remap it at seal time
+        self.valid_docs: Optional[np.ndarray] = None
+        self.sort_order: Optional[np.ndarray] = None
         self._device_cache: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------

@@ -126,6 +126,22 @@ class UpsertConfig:
 
 
 @dataclass
+class DedupConfig:
+    """Exact-duplicate dropping by primary key at ingest time (pinot-spi
+    DedupConfig analog): the FIRST row per PK wins; later rows are dropped
+    before indexing."""
+
+    enabled: bool = True
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"dedupEnabled": self.enabled}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "DedupConfig":
+        return DedupConfig(enabled=bool(d.get("dedupEnabled", True)))
+
+
+@dataclass
 class StreamConfig:
     """Realtime stream binding (pinot-spi stream SPI analog): consumer factory
     name + free-form properties (topic, decoder, end-criteria)."""
@@ -167,6 +183,7 @@ class TableConfig:
     indexing: IndexingConfig = field(default_factory=IndexingConfig)
     segments: SegmentsConfig = field(default_factory=SegmentsConfig)
     upsert: Optional[UpsertConfig] = None
+    dedup: Optional[DedupConfig] = None
     stream: Optional[StreamConfig] = None
     # Partitioning for partition-pinned parallelism (SURVEY.md 2.5):
     # column name -> number of partitions.
@@ -188,6 +205,8 @@ class TableConfig:
         }
         if self.upsert:
             d["upsertConfig"] = self.upsert.to_dict()
+        if self.dedup:
+            d["dedupConfig"] = self.dedup.to_dict()
         if self.stream:
             d["streamConfigs"] = self.stream.to_dict()
         if self.partition_column:
@@ -203,6 +222,7 @@ class TableConfig:
             indexing=IndexingConfig.from_dict(d.get("tableIndexConfig", {})),
             segments=SegmentsConfig.from_dict(d.get("segmentsConfig", {})),
             upsert=UpsertConfig.from_dict(d["upsertConfig"]) if d.get("upsertConfig") else None,
+            dedup=DedupConfig.from_dict(d["dedupConfig"]) if d.get("dedupConfig") else None,
             stream=StreamConfig.from_dict(d["streamConfigs"]) if d.get("streamConfigs") else None,
             partition_column=d.get("partitionColumn"),
             num_partitions=int(d.get("numPartitions", 0)),

@@ -307,25 +307,21 @@ class DistributedEngine:
             out_specs = P()
 
         elif kind == "groupby_sparse":
+            # Per-device sort+scatter into fixed [numGroupsLimit] tables
+            # (planner_mod.sparse_grouped_tables); only [ndev*K] tables cross
+            # PCIe — never row-length arrays.  Cross-device key merge happens
+            # host-side in sparse_tables_to_result (IndexedTable combine).
+            if num_groups >= (1 << 62):
+                raise NotImplementedError("composite group key exceeds 62 bits")
+            num_slots = min(ctx.num_groups_limit, num_groups)
 
             def shard_kernel(cols, valid, params):
                 cols = _flat(cols)
                 tmask, _ = filter_fn(cols, params)
                 tmask = tmask & valid.reshape(-1)
-                codes = []
-                for gd in group_dims:
-                    if gd.kind == "dict":
-                        codes.append(cols[gd.name]["codes"].astype(jnp.int32))
-                    else:
-                        v = cols[gd.name]["values"]
-                        codes.append((v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int32))
+                key = planner_mod.packed_key64(cols, group_dims)
                 inputs = _agg_inputs(cols, params, tmask)
-                # broadcast scalar vals (COUNT) to full length for host gather
-                inputs = [
-                    (jnp.broadcast_to(v, tmask.shape) if getattr(v, "ndim", 0) == 0 else v, m)
-                    for v, m in inputs
-                ]
-                return tmask, codes, inputs
+                return planner_mod.sparse_grouped_tables(aggs, inputs, tmask, key, num_slots)
 
             out_specs = P(self.axis)
 
@@ -432,9 +428,10 @@ class DistributedEngine:
             return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense)
 
         if plan.kind == "groupby_sparse":
-            tmask, codes, inputs = jax.device_get(plan.fn(cols, valid, params))
-            shim = SimpleNamespace(group_dims=plan.group_dims, aggs=plan.aggs)
-            res = sse_executor._host_sparse_groupby(shim, tmask, codes, inputs, ctx.num_groups_limit)
+            uniq, partials = jax.device_get(plan.fn(cols, valid, params))
+            res = sse_executor.sparse_tables_to_result(
+                plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit
+            )
             stats.num_groups = len(res.keys[0]) if res.keys else 0
             return res
 

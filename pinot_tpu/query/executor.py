@@ -128,8 +128,8 @@ def execute_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
         return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense), stats
 
     if plan.kind == "groupby_sparse":
-        tmask, codes, inputs = jax.device_get(plan.fn(cols, params))
-        res = _host_sparse_groupby(plan, tmask, codes, inputs, ctx.num_groups_limit)
+        uniq, partials = jax.device_get(plan.fn(cols, params))
+        res = sparse_tables_to_result(plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit)
         stats.num_groups = len(res.keys[0]) if res.keys else 0
         return res, stats
 
@@ -164,56 +164,55 @@ def _dense_to_present(
     return keys, sliced
 
 
-def _host_sparse_groupby(plan, tmask, codes, inputs, num_groups_limit: int) -> GroupBySegmentResult:
-    """Vectorized host groupby for key spaces too large for a dense table
-    (IndexedTable analog; future Pallas hash-table kernel replaces this)."""
-    sel = np.nonzero(np.asarray(tmask))[0]
-    packed = np.zeros(len(sel), dtype=np.int64)
-    scale = 1
-    for gd, c in zip(reversed(plan.group_dims), [np.asarray(c)[sel] for c in reversed(codes)]):
-        if scale > (1 << 62) // max(1, gd.cardinality):
-            raise NotImplementedError("composite group key exceeds 63 bits")
-        packed += c.astype(np.int64) * scale
-        scale *= gd.cardinality
-    uniq, inverse = np.unique(packed, return_inverse=True)
-    if len(uniq) > num_groups_limit:
+def sparse_tables_to_result(
+    group_dims, aggs, uniq, partials, num_groups_limit: int
+) -> GroupBySegmentResult:
+    """Decode fixed-size sparse group tables (planner.sparse_grouped_tables)
+    into a GroupBySegmentResult, merging slots that share a key.
+
+    Handles both the single-kernel shape ([K] tables, keys already unique)
+    and the multi-device shape ([ndev*K] concatenated per-device tables,
+    where the same key may appear on several devices — the IndexedTable
+    merge the reference runs in CombineOperator).  Only table-sized arrays
+    are touched; nothing here is row-length."""
+    uniq = np.asarray(uniq).reshape(-1)
+    present = uniq != planner.SPARSE_EMPTY_KEY
+    keys_flat = uniq[present]
+    u, inverse = np.unique(keys_flat, return_inverse=True)
+    if len(u) > num_groups_limit:
         # numGroupsLimit safety valve (InstancePlanMakerImplV2.java:100-120):
-        # cap tracked groups.  Pinot keeps first-seen arrival order; the
-        # vectorized analog keeps the lowest keys — deterministic, documented.
+        # lowest packed keys win — deterministic, documented trim.
         keep = inverse < num_groups_limit
-        sel = sel[keep]
-        inverse = inverse[keep]
-        uniq = uniq[:num_groups_limit]
-    n_groups = len(uniq)
-    keys = planner.decode_packed_keys(plan.group_dims, uniq)
-    partials: List[Dict[str, np.ndarray]] = []
-    for fn, (vals, mask) in zip(plan.aggs, inputs):
-        vals = np.asarray(vals)
-        mask = np.asarray(mask)[sel]
-        v = vals[sel] if vals.ndim else np.broadcast_to(vals, (len(sel),))
-        p: Dict[str, np.ndarray] = {}
-        # reconstruct the same fields the device path produces, via FIELD_COMBINE
-        for fname in fn.fields:
-            if FIELD_COMBINE[fname] == "add":
+        u = u[:num_groups_limit]
+    else:
+        keep = None
+    n_groups = len(u)
+    keys = planner.decode_packed_keys(group_dims, u)
+    out: List[Dict[str, np.ndarray]] = []
+    for fn, p in zip(aggs, partials):
+        d: Dict[str, np.ndarray] = {}
+        for fname, arr in p.items():
+            a = np.asarray(arr).reshape(-1)[present]
+            inv = inverse
+            if keep is not None:
+                a = a[keep]
+                inv = inverse[keep]
+            comb = FIELD_COMBINE[fname]
+            if comb == "add":
                 if fname == "count":
-                    p[fname] = np.bincount(inverse, weights=mask.astype(np.float64), minlength=n_groups).astype(np.int64)
-                elif fname == "sumsq":
-                    w = np.where(mask, v.astype(np.float64) ** 2, 0.0)
-                    p[fname] = np.bincount(inverse, weights=w, minlength=n_groups)
+                    acc = np.zeros(n_groups, dtype=np.int64)
+                    np.add.at(acc, inv, a)
                 else:
-                    w = np.where(mask, v.astype(np.float64), 0.0)
-                    p[fname] = np.bincount(inverse, weights=w, minlength=n_groups)
+                    acc = np.bincount(inv, weights=a, minlength=n_groups)
             else:
-                ident = field_identity(fname)
-                out = np.full(n_groups, ident)
-                masked = np.where(mask, v.astype(np.float64), ident)
-                if FIELD_COMBINE[fname] == "min":
-                    np.minimum.at(out, inverse, masked)
+                acc = np.full(n_groups, field_identity(fname))
+                if comb == "min":
+                    np.minimum.at(acc, inv, a)
                 else:
-                    np.maximum.at(out, inverse, masked)
-                p[fname] = out
-        partials.append(p)
-    return GroupBySegmentResult(keys=keys, partials=partials, dense=None)
+                    np.maximum.at(acc, inv, a)
+            d[fname] = acc
+        out.append(d)
+    return GroupBySegmentResult(keys=keys, partials=out, dense=None)
 
 
 def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask: np.ndarray) -> SelectionSegmentResult:

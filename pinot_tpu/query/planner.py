@@ -33,7 +33,13 @@ import numpy as np
 
 from pinot_tpu import ops
 from pinot_tpu.query.filter import FilterCompiler
-from pinot_tpu.query.functions import AggFunction, for_spec, get_agg_function
+from pinot_tpu.query.functions import (
+    FIELD_COMBINE,
+    AggFunction,
+    field_identity,
+    for_spec,
+    get_agg_function,
+)
 from pinot_tpu.query.ir import AggregationSpec, Expr, QueryContext
 from pinot_tpu.query.transform import as_row_array, eval_expr
 from pinot_tpu.segment.segment import ImmutableSegment
@@ -467,6 +473,90 @@ def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges):
     return presence, partials
 
 
+# sentinel packed key for rows filtered out / slots never written; all real
+# packed keys are >= 0, so int64 max never collides
+SPARSE_EMPTY_KEY = np.int64(np.iinfo(np.int64).max)
+
+
+def packed_key64(cols, group_dims) -> jnp.ndarray:
+    """Ravel per-dim codes into one int64 key (device side).  The planner
+    guards the key space to < 2^62 before choosing the sparse path."""
+    key = None
+    for gd in group_dims:
+        if gd.kind == "dict":
+            code = cols[gd.name]["codes"].astype(jnp.int64)
+        else:
+            v = cols[gd.name]["values"]
+            code = (v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int64)
+        key = code if key is None else key * np.int64(gd.cardinality) + code
+    return key
+
+
+def sparse_grouped_tables(aggs, inputs, tmask, key, num_slots: int):
+    """Device-side high-cardinality group-by: sort + segment-scatter into
+    FIXED-size tables (the IndexedTable analog with numGroupsLimit trim
+    built into the kernel).
+
+    Replaces the round-1/2 host fallback that device_get the mask, codes and
+    every agg input for ALL rows (tens of GB over PCIe at 1B rows).  Now the
+    kernel returns [num_slots]-sized tables only:
+
+      sort rows by packed key (filtered rows get SPARSE_EMPTY_KEY, sorting
+      last) -> group starts where the sorted key changes -> running group
+      index = cumsum(starts) -> rows beyond num_slots groups scatter into a
+      dropped overflow slot.  Sorted keys make the trim deterministic (lowest
+      keys win — the documented analog of Pinot's first-arrival trim).
+
+    Accumulation dtypes mirror the host reduce contracts: counts int64,
+    sums/sumsq float64 (exact for int sums < 2^53 — the reference likewise
+    accumulates long sums in double), min/max float64.  This path is
+    scatter/HBM-bound, not MXU-bound, so f64 costs little on TPU here.
+
+    Returns (uniq_keys[num_slots] int64 with SPARSE_EMPTY_KEY padding,
+             [{field: table[num_slots]}] per agg)."""
+    from jax import lax
+
+    n = tmask.shape[0]
+    k64 = jnp.where(tmask, key, SPARSE_EMPTY_KEY)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    skey, perm = lax.sort((k64, iota), num_keys=1)
+    smask = tmask[perm]
+    prev = jnp.concatenate([jnp.full((1,), -1, skey.dtype), skey[:-1]])
+    is_start = smask & (skey != prev)
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    # slot num_slots = overflow/invalid bin, sliced off before returning
+    slot = jnp.where(smask & (seg < num_slots), seg, num_slots)
+    uniq = (
+        jnp.full((num_slots + 1,), SPARSE_EMPTY_KEY, dtype=jnp.int64)
+        .at[jnp.where(is_start, slot, num_slots)]
+        .set(skey)
+    )
+    partials = []
+    for fn, (vals, mask) in zip(aggs, inputs):
+        m = mask[perm]
+        v = vals if getattr(vals, "ndim", 0) else jnp.broadcast_to(vals, (n,))
+        v = v[perm]
+        p: Dict[str, Any] = {}
+        for fname in fn.fields:
+            comb = FIELD_COMBINE[fname]
+            if comb == "add":
+                if fname == "count":
+                    acc = jnp.zeros((num_slots + 1,), jnp.int64).at[slot].add(m.astype(jnp.int64))
+                else:
+                    w = v.astype(jnp.float64)
+                    if fname == "sumsq":
+                        w = w * w
+                    acc = jnp.zeros((num_slots + 1,), jnp.float64).at[slot].add(jnp.where(m, w, 0.0))
+            else:
+                ident = field_identity(fname)
+                masked = jnp.where(m, v.astype(jnp.float64), ident)
+                base = jnp.full((num_slots + 1,), ident, jnp.float64)
+                acc = base.at[slot].min(masked) if comb == "min" else base.at[slot].max(masked)
+            p[fname] = acc[:num_slots]
+        partials.append(p)
+    return uniq[:num_slots], partials
+
+
 def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     needed = _needed_columns(ctx, segment)
     key = (ctx.fingerprint(), _segment_signature(segment, needed, sketch_bound_columns(ctx)))
@@ -589,20 +679,17 @@ def _build_plan(
             return grouped_partials(aggs, inputs, tmask, key, num_groups, vranges)
 
     elif kind == "groupby_sparse":
-        # Device computes mask + per-dim codes + agg inputs; host finishes the
-        # groupby (executor._execute_groupby_sparse).
+        # Device-side sort+scatter into fixed [numGroupsLimit] tables — no
+        # row-length arrays ever leave the device (sparse_grouped_tables).
+        if num_groups >= (1 << 62):
+            raise NotImplementedError("composite group key exceeds 62 bits")
+        num_slots = min(ctx.num_groups_limit, num_groups)
+
         def kernel(cols, params):
             tmask, _ = filter_fn(cols, params)
-            key = None  # codes per dim, not raveled (host packs into int64)
-            codes = []
-            for gd in group_dims:
-                if gd.kind == "dict":
-                    codes.append(cols[gd.name]["codes"].astype(jnp.int32))
-                else:
-                    v = cols[gd.name]["values"]
-                    codes.append((v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int32))
+            key = packed_key64(cols, group_dims)
             inputs = _agg_inputs(cols, params, tmask)
-            return tmask, codes, inputs
+            return sparse_grouped_tables(aggs, inputs, tmask, key, num_slots)
 
     else:  # selection
 

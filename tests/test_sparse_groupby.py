@@ -1,0 +1,140 @@
+"""Device-side high-cardinality (sparse) group-by tests.
+
+The IndexedTable analog (reference: pinot-core/.../core/data/table/
+IndexedTable.java:46,105-123) now runs on-device: sort + segment-scatter
+into fixed numGroupsLimit-sized tables.  These tests pin:
+  * correctness vs sqlite at key spaces past the dense-table bound
+  * NO row-length array ever crosses device_get (the round-1/2 regression)
+  * deterministic numGroupsLimit trim (lowest packed keys win)
+  * the distributed path merges per-device tables by key
+"""
+import numpy as np
+import pytest
+
+import pinot_tpu.query.executor as executor_mod
+from pinot_tpu.parallel.engine import DistributedEngine
+from pinot_tpu.parallel.stacked import StackedTable
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.sql.parser import parse_query
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+from golden import assert_same_rows, sqlite_from_data
+
+N = 60_000
+
+
+def _schema():
+    return Schema(
+        "hc",
+        [
+            FieldSpec("k1", DataType.INT),
+            FieldSpec("k2", DataType.INT),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("w", DataType.DOUBLE, role=FieldRole.METRIC),
+        ],
+    )
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(17)
+    return {
+        # 1500 x 1500 = 2.25M key space > maxDenseGroups (1M) -> sparse path
+        "k1": rng.integers(0, 1500, N).astype(np.int32),
+        "k2": rng.integers(0, 1500, N).astype(np.int32),
+        "v": rng.integers(-50, 5000, N),
+        "w": np.round(rng.random(N) * 100, 3),
+    }
+
+
+@pytest.fixture(scope="module")
+def sse(data):
+    eng = QueryEngine()
+    eng.register_table(_schema())
+    eng.add_segment("hc", build_segment(_schema(), data, "s0"))
+    return eng
+
+
+@pytest.fixture(scope="module")
+def conn(data):
+    return sqlite_from_data("hc", data)
+
+
+SPARSE_SQL = "SELECT k1, k2, COUNT(*), SUM(v), MIN(v), MAX(w), AVG(w) FROM hc GROUP BY k1, k2"
+
+
+class TestSparseGroupBy:
+    def test_plan_kind_is_sparse(self, sse):
+        from pinot_tpu.query import planner
+
+        ctx = parse_query(SPARSE_SQL)
+        seg = sse.table("hc").segments[0]
+        plan = planner.plan_segment(ctx, seg)
+        assert plan.kind == "groupby_sparse"
+
+    def test_matches_sqlite(self, sse, conn):
+        sql = SPARSE_SQL + " ORDER BY k1, k2 LIMIT 100"
+        assert_same_rows(sse.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+    def test_filtered_sparse_matches_sqlite(self, sse, conn):
+        sql = (
+            "SELECT k1, k2, SUM(v), COUNT(*) FROM hc WHERE v > 2500 "
+            "GROUP BY k1, k2 ORDER BY k1 DESC, k2 DESC LIMIT 50"
+        )
+        assert_same_rows(sse.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+    def test_no_row_length_device_transfer(self, sse, monkeypatch):
+        """The kernel must return only table-sized arrays — the whole point
+        of killing the host np.unique fallback."""
+        import jax
+
+        seen_sizes = []
+        real_get = jax.device_get
+
+        def spy(x):
+            for leaf in jax.tree_util.tree_leaves(x):
+                seen_sizes.append(int(np.asarray(leaf).size))
+            return real_get(x)
+
+        monkeypatch.setattr(jax, "device_get", spy)
+        ctx = parse_query(SPARSE_SQL)
+        ctx.options["numGroupsLimit"] = 5000  # tables are limit-sized, not row-sized
+        sse.execute(ctx)
+        assert seen_sizes, "device_get never called?"
+        assert max(seen_sizes) <= 5000, f"array larger than the group table crossed PCIe: {max(seen_sizes)}"
+
+    def test_num_groups_limit_trim_deterministic(self, sse):
+        ctx = parse_query("SELECT k1, k2, COUNT(*) FROM hc GROUP BY k1, k2 LIMIT 100000")
+        ctx.options["numGroupsLimit"] = 500
+        res = sse.execute(ctx)
+        assert len(res.rows) == 500
+        # lowest packed (k1, k2) keys win the trim
+        got = sorted((r[0], r[1]) for r in res.rows)
+        assert got == sorted(got)[:500]
+        ctx2 = parse_query("SELECT k1, k2, COUNT(*) FROM hc GROUP BY k1, k2 LIMIT 100000")
+        ctx2.options["numGroupsLimit"] = 500
+        res2 = sse.execute(ctx2)
+        assert sorted(map(tuple, res.rows)) == sorted(map(tuple, res2.rows))
+
+
+class TestDistributedSparse:
+    @pytest.fixture(scope="class")
+    def dist(self, data):
+        st = StackedTable.build(_schema(), data, 8)
+        eng = DistributedEngine()
+        eng.register_table("hc", st)
+        return eng
+
+    def test_distributed_matches_sqlite(self, dist, conn):
+        sql = SPARSE_SQL + " ORDER BY k1, k2 LIMIT 100"
+        assert_same_rows(dist.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+    def test_cross_device_key_merge(self, dist, conn):
+        """Groups spanning shards must merge, not duplicate."""
+        sql = "SELECT k1, COUNT(*), SUM(v) FROM hc GROUP BY k1 ORDER BY k1 LIMIT 2000"
+        ctx = parse_query(sql)
+        ctx.options["maxDenseGroups"] = 100  # force the sparse path at card 1500
+        res = dist.execute(ctx)
+        expected = conn.execute(sql).fetchall()
+        assert_same_rows(res.rows, expected, ordered=True)

@@ -265,11 +265,7 @@ class DistributedEngine:
         def _group_key(cols):
             key = None
             for gd in group_dims:
-                if gd.kind == "dict":
-                    code = cols[gd.name]["codes"].astype(jnp.int32)
-                else:
-                    v = cols[gd.name]["values"]
-                    code = (v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int32)
+                code = gd.device_code(cols, view, jnp.int32)
                 key = code if key is None else key * np.int32(gd.cardinality) + code
             return key
 
@@ -319,7 +315,7 @@ class DistributedEngine:
                 cols = _flat(cols)
                 tmask, _ = filter_fn(cols, params)
                 tmask = tmask & valid.reshape(-1)
-                key = planner_mod.packed_key64(cols, group_dims)
+                key = planner_mod.packed_key64(cols, group_dims, view)
                 inputs = _agg_inputs(cols, params, tmask)
                 return planner_mod.sparse_grouped_tables(aggs, inputs, tmask, key, num_slots)
 

@@ -19,6 +19,7 @@ import numpy as np
 from pinot_tpu.query import planner
 from pinot_tpu.query.functions import FIELD_COMBINE, field_identity
 from pinot_tpu.query.ir import FilterNode, FilterOp, PredicateType, QueryContext
+from pinot_tpu.query.transform import eval_expr_host
 from pinot_tpu.query.result import (
     AggSegmentResult,
     DenseGroupData,
@@ -250,13 +251,34 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
             vals[c.nulls[docids]] = None
         return vals
 
-    for name in plan.select_columns:
-        arrays[name] = _decoded(name)
+    out_keys: List[str] = []
+    items = plan.select_exprs or [planner.Expr.col(n) for n in plan.select_columns]
+    for i, e in enumerate(items):
+        if e.is_column:
+            out_keys.append(e.op)
+            arrays[e.op] = _decoded(e.op)
+            continue
+        # expression select item: host evaluation over the gathered rows only
+        # (O(limit), TransformOperator-on-selection analog)
+        key = f"__sel{i}"
+        out_keys.append(key)
+        vals = eval_expr_host(e, segment, docids)
+        nmask = None
+        if ctx.null_handling:
+            for cname in e.columns():
+                cn = segment.column(cname).nulls
+                if cn is not None:
+                    m = cn[docids]
+                    nmask = m if nmask is None else (nmask | m)
+        if nmask is not None and nmask.any():
+            vals = np.asarray(vals, dtype=object)
+            vals[nmask] = None
+        arrays[key] = vals
     # Cross-segment merge needs real VALUES for order columns (codes are
     # segment-local); reduce.py re-sorts the concatenated trimmed rows.
     for i, ob in enumerate(ctx.order_by):
         arrays[f"__ord{i}"] = _decoded(ob.expr.op)
-    cols = plan.select_columns + [f"__ord{i}" for i in range(len(ctx.order_by))]
+    cols = out_keys + [f"__ord{i}" for i in range(len(ctx.order_by))]
     return SelectionSegmentResult(columns=cols, arrays=arrays)
 
 

@@ -38,6 +38,37 @@ MaskPair = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
 Params = Dict[str, np.ndarray]
 
 
+def _match_values(p, values: np.ndarray) -> np.ndarray:
+    """Evaluate a predicate over a (derived) value array -> bool table.
+    Used by derived-string predicates, where codes are NOT sort ranks of the
+    derived values, so everything is a table lookup (no code ranges)."""
+    pt = p.ptype
+    if pt is PredicateType.EQ:
+        return np.array([v == p.values[0] for v in values], dtype=bool)
+    if pt is PredicateType.NEQ:
+        return np.array([v != p.values[0] for v in values], dtype=bool)
+    if pt in (PredicateType.IN, PredicateType.NOT_IN):
+        s = set(p.values)
+        t = np.array([v in s for v in values], dtype=bool)
+        return ~t if pt is PredicateType.NOT_IN else t
+    if pt is PredicateType.RANGE:
+        t = np.ones(len(values), dtype=bool)
+        if p.lower is not None:
+            t &= np.array(
+                [(v >= p.lower if p.lower_inclusive else v > p.lower) for v in values], dtype=bool
+            )
+        if p.upper is not None:
+            t &= np.array(
+                [(v <= p.upper if p.upper_inclusive else v < p.upper) for v in values], dtype=bool
+            )
+        return t
+    if pt in (PredicateType.REGEXP_LIKE, PredicateType.LIKE):
+        pat = p.values[0]
+        rx = re.compile(pat if pt is PredicateType.REGEXP_LIKE else like_to_regex(pat))
+        return np.array([rx.search(str(v)) is not None for v in values], dtype=bool)
+    raise ValueError(f"predicate {pt} not supported on derived string values")
+
+
 def like_to_regex(pattern: str) -> str:
     """SQL LIKE -> anchored regex (Pinot LikeToRegexpLikePatternConverter)."""
     out = []
@@ -186,7 +217,43 @@ class FilterCompiler:
 
         if p.lhs.is_column and seg.column(p.lhs.op).has_dictionary:
             return self._compile_dict_predicate(p)
+        from pinot_tpu.query import scalar
+
+        if (
+            scalar.is_dict_fn_expr(p.lhs)
+            and p.lhs.op in scalar.STRING_RESULT_DICT_FNS
+        ):
+            return self._compile_derived_string_predicate(p)
         return self._compile_value_predicate(p)
+
+    def _compile_derived_string_predicate(self, p: Predicate) -> Callable[[Dict, Dict], MaskPair]:
+        """Predicate over a string function of a dict column — e.g.
+        WHERE UPPER(city) = 'SF'.  The function evaluates over the
+        DICTIONARY'S VALUES (cardinality work, host-side), the predicate over
+        the derived values yields a code table, and the device work is the
+        same table[codes] lookup as any dictionary predicate."""
+        from pinot_tpu.query import scalar
+
+        name = next(a for a in p.lhs.args if not a.is_literal).op
+        col = self.segment.column(name)
+        if not col.has_dictionary:
+            raise ValueError(f"{p.lhs.op} predicate requires dictionary column, {name} is raw")
+        derived = scalar.eval_dict_fn(p.lhs, col.dictionary.values)
+        table = _match_values(p, derived)
+        has_nulls = col.nulls is not None and self.null_handling
+        key = self._key("dtable")
+        self.params[key] = table
+        self.used_columns.add(name)
+
+        def eval_table(cols, params, _key=key, _name=name, _has=has_nulls):
+            codes = cols[_name]["codes"].astype(jnp.int32)
+            t = params[_key][codes]
+            nulls = cols[_name].get("nulls") if _has else None
+            if nulls is not None:
+                t = t & ~nulls
+            return t, nulls
+
+        return eval_table
 
     # -- dictionary-based ------------------------------------------------
     def _compile_dict_predicate(self, p: Predicate) -> Callable[[Dict, Dict], MaskPair]:

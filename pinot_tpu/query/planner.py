@@ -50,15 +50,27 @@ MAX_DENSE_RAW_INT_RANGE = 1 << 20  # raw ints join the dense keyspace when (max-
 
 @dataclass
 class GroupDim:
-    """How one group-by dimension maps into the dense key space."""
+    """How one group-by dimension maps into the dense key space.
+
+    kinds:
+      dict    - dictionary codes of a column
+      rawint  - integer column values shifted by base
+      expr    - integer-valued device expression shifted by base (range
+                bounded statically by scalar.expr_int_range)
+      derived - dict column remapped through a host-computed derived
+                dictionary (string functions: code -> remap[code], decode via
+                derived_values) — Pinot's expression group-by over strings
+    """
 
     expr: Expr
     name: str
-    kind: str  # "dict" | "rawint"
+    kind: str  # "dict" | "rawint" | "expr" | "derived"
     cardinality: int
     dictionary: Optional[Any] = None  # Dictionary for kind=dict
-    base: int = 0  # min value for kind=rawint
+    base: int = 0  # min value for kind=rawint/expr
     null_code: int = -1  # code representing SQL NULL (placeholder), -1 if none
+    derived_values: Optional[np.ndarray] = None  # kind=derived decode table
+    remap: Optional[np.ndarray] = None  # kind=derived code remap (int32)
 
     def decode(self, codes: np.ndarray) -> np.ndarray:
         if self.kind == "dict":
@@ -66,12 +78,30 @@ class GroupDim:
             # no-match rows, mse/engine.py) — clip before the gather
             card = self.dictionary.cardinality
             vals = self.dictionary.get_values(np.minimum(np.asarray(codes), card - 1))
+        elif self.kind == "derived":
+            vals = self.derived_values[np.minimum(np.asarray(codes), len(self.derived_values) - 1)]
         else:
             vals = codes.astype(np.int64) + self.base
         if self.null_code >= 0:
             vals = np.asarray(vals, dtype=object)
             vals[np.asarray(codes) == self.null_code] = None
         return vals
+
+    def device_code(self, cols, segment, dtype=None):
+        """Traced per-row dimension code (the group-key contribution)."""
+        from pinot_tpu.query.transform import eval_expr
+
+        dtype = dtype or jnp.int32
+        if self.kind == "dict":
+            return cols[self.name]["codes"].astype(dtype)
+        if self.kind == "rawint":
+            v = cols[self.name]["values"]
+            # subtract in storage dtype (np scalar: no x64 promotion)
+            return (v - np.asarray(self.base, dtype=v.dtype)).astype(dtype)
+        if self.kind == "derived":
+            return jnp.asarray(self.remap)[cols[self.name]["codes"].astype(jnp.int32)].astype(dtype)
+        v, _ = eval_expr(self.expr, segment, cols)
+        return (v.astype(jnp.int64) - np.int64(self.base)).astype(dtype)
 
 
 def group_strides(group_dims: List["GroupDim"]) -> List[int]:
@@ -105,6 +135,8 @@ class SegmentPlan:
     group_dims: List[GroupDim] = field(default_factory=list)
     num_groups: int = 0
     select_columns: List[str] = field(default_factory=list)
+    # selection output items in order (columns AND expressions)
+    select_exprs: List[Expr] = field(default_factory=list)
     # (column, index kind) per index-accelerated filter predicate
     index_uses: List[Tuple[str, str]] = field(default_factory=list)
 
@@ -177,6 +209,46 @@ def sketch_bound_columns(ctx: QueryContext) -> frozenset:
     for spec in ctx.aggregations:
         if spec.expr is not None and spec.expr.is_column and for_spec(spec).needs_binding:
             out.add(spec.expr.op)
+    return frozenset(out)
+
+
+def const_bound_columns(ctx: QueryContext) -> frozenset:
+    """Columns whose DICTIONARY VALUES are baked into compiled kernels as
+    closure constants: any column under a dictionary-domain function call
+    (derived arrays, transform.py) or an expression group-by (derived remap
+    / expr ranges).  Their dictionary fingerprint must join the plan-cache
+    signature or a same-shaped segment would reuse another segment's
+    constants (same hazard as sketch bindings)."""
+    from pinot_tpu.query import scalar
+
+    out = set()
+
+    def visit(e: Expr) -> None:
+        if e is None:
+            return
+        if e.kind.name == "CALL":
+            if e.op in scalar.DICT_FNS:
+                out.update(e.columns())
+            for a in e.args:
+                visit(a)
+
+    def visit_filter(node) -> None:
+        if node is None:
+            return
+        if node.predicate is not None:
+            visit(node.predicate.lhs)
+        for ch in node.children:
+            visit_filter(ch)
+
+    for g in ctx.group_by:
+        if not g.is_column:
+            out.update(g.columns())  # expr dims bake ranges/remaps
+    for spec in list(ctx.aggregations):
+        if spec.expr is not None:
+            visit(spec.expr)
+        if spec.filter is not None:
+            visit_filter(spec.filter)
+    visit_filter(ctx.filter)
     return frozenset(out)
 
 
@@ -253,23 +325,47 @@ def _non_filter_columns(ctx: QueryContext, segment) -> set:
 
 
 def _group_dim(expr: Expr, segment: ImmutableSegment, null_handling: bool) -> GroupDim:
-    if not expr.is_column:
-        raise NotImplementedError(f"group-by on expression {expr} not yet supported (bare columns only)")
-    c = segment.column(expr.op)
-    null_code = -1
-    if c.has_dictionary:
-        if c.nulls is not None and null_handling:
-            nc = c.dictionary.index_of(c.data_type.null_placeholder)
-            if nc >= 0:
-                null_code = nc
-        return GroupDim(expr, c.name, "dict", c.dictionary.cardinality, dictionary=c.dictionary, null_code=null_code)
-    if c.data_type in (DataType.INT, DataType.LONG, DataType.TIMESTAMP, DataType.BOOLEAN):
-        lo, hi = int(c.stats.min_value), int(c.stats.max_value)
-        rng = hi - lo + 1
-        if rng <= MAX_DENSE_RAW_INT_RANGE:
+    from pinot_tpu.query import scalar
+
+    if expr.is_column:
+        c = segment.column(expr.op)
+        null_code = -1
+        if c.has_dictionary:
+            if c.nulls is not None and null_handling:
+                nc = c.dictionary.index_of(c.data_type.null_placeholder)
+                if nc >= 0:
+                    null_code = nc
+            return GroupDim(expr, c.name, "dict", c.dictionary.cardinality, dictionary=c.dictionary, null_code=null_code)
+        if c.data_type in (DataType.INT, DataType.LONG, DataType.TIMESTAMP, DataType.BOOLEAN):
+            lo, hi = int(c.stats.min_value), int(c.stats.max_value)
+            rng = hi - lo + 1
             return GroupDim(expr, c.name, "rawint", rng, base=lo)
+        raise NotImplementedError(f"group-by on raw {c.data_type.value} column {c.name} is not groupable")
+    # GROUP BY <expression> (ExpressionContext function analog):
+    # string-valued dictionary function -> derived dictionary dimension
+    if scalar.is_dict_fn_expr(expr) and expr.op in scalar.STRING_RESULT_DICT_FNS:
+        col = next(a for a in expr.args if not a.is_literal).op
+        c = segment.column(col)
+        if c.has_dictionary:
+            derived = scalar.eval_dict_fn(expr, c.dictionary.values)
+            uniq, remap = np.unique(derived, return_inverse=True)
+            return GroupDim(
+                expr,
+                col,
+                "derived",
+                len(uniq),
+                derived_values=uniq,
+                remap=remap.astype(np.int32),
+            )
+    # integer-valued device expression -> statically bounded expr dimension
+    # (GROUP BY DATETRUNC('day', ts) — the archetypal OLAP bucketing)
+    rng = scalar.expr_int_range(expr, segment)
+    if rng is not None:
+        lo, hi = rng
+        return GroupDim(expr, str(expr), "expr", hi - lo + 1, base=lo)
     raise NotImplementedError(
-        f"group-by on raw column {c.name} ({c.data_type.value}, range too wide) requires the sparse path"
+        f"group-by expression {expr} is not supported: its integer range cannot be "
+        "bounded from column stats and it is not a dictionary string function"
     )
 
 
@@ -478,16 +574,12 @@ def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges):
 SPARSE_EMPTY_KEY = np.int64(np.iinfo(np.int64).max)
 
 
-def packed_key64(cols, group_dims) -> jnp.ndarray:
+def packed_key64(cols, group_dims, segment) -> jnp.ndarray:
     """Ravel per-dim codes into one int64 key (device side).  The planner
     guards the key space to < 2^62 before choosing the sparse path."""
     key = None
     for gd in group_dims:
-        if gd.kind == "dict":
-            code = cols[gd.name]["codes"].astype(jnp.int64)
-        else:
-            v = cols[gd.name]["values"]
-            code = (v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int64)
+        code = gd.device_code(cols, segment, jnp.int64)
         key = code if key is None else key * np.int64(gd.cardinality) + code
     return key
 
@@ -559,7 +651,10 @@ def sparse_grouped_tables(aggs, inputs, tmask, key, num_slots: int):
 
 def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     needed = _needed_columns(ctx, segment)
-    key = (ctx.fingerprint(), _segment_signature(segment, needed, sketch_bound_columns(ctx)))
+    key = (
+        ctx.fingerprint(),
+        _segment_signature(segment, needed, sketch_bound_columns(ctx) | const_bound_columns(ctx)),
+    )
     cached = _PLAN_CACHE.get(key)
     if cached is not None:
         # params are per-segment (dictionary-dependent): rebuild them, reuse fn
@@ -654,12 +749,7 @@ def _build_plan(
     def _group_key(cols, params):
         key = None
         for gd in group_dims:
-            if gd.kind == "dict":
-                code = cols[gd.name]["codes"].astype(jnp.int32)
-            else:
-                v = cols[gd.name]["values"]
-                # subtract in storage dtype (np scalar: no x64 promotion)
-                code = (v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int32)
+            code = gd.device_code(cols, segment, jnp.int32)
             key = code if key is None else key * np.int32(gd.cardinality) + code
         return key
 
@@ -687,7 +777,7 @@ def _build_plan(
 
         def kernel(cols, params):
             tmask, _ = filter_fn(cols, params)
-            key = packed_key64(cols, group_dims)
+            key = packed_key64(cols, group_dims, segment)
             inputs = _agg_inputs(cols, params, tmask)
             return sparse_grouped_tables(aggs, inputs, tmask, key, num_slots)
 
@@ -700,15 +790,16 @@ def _build_plan(
     fn = compiled_fn if compiled_fn is not None else jax.jit(kernel)
 
     select_columns = []
+    select_exprs: List[Expr] = []
     if kind == "selection":
         for s in ctx.select_list:
-            if isinstance(s, Expr) and s.is_column:
-                if s.op == "*":
-                    select_columns.extend(segment.schema.column_names)
-                else:
-                    select_columns.append(s.op)
+            if not isinstance(s, Expr):
+                raise NotImplementedError(f"unsupported selection item {s}")
+            if s.is_column and s.op == "*":
+                select_exprs.extend(Expr.col(n) for n in segment.schema.column_names)
             else:
-                raise NotImplementedError(f"selection expression {s} not yet supported (bare columns / *)")
+                select_exprs.append(s)
+        select_columns = [e.op for e in select_exprs if e.is_column]
 
     return SegmentPlan(
         kind=kind,
@@ -719,5 +810,6 @@ def _build_plan(
         group_dims=group_dims,
         num_groups=num_groups,
         select_columns=select_columns,
+        select_exprs=select_exprs,
         index_uses=list(fc.index_uses),
     )

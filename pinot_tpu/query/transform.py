@@ -17,6 +17,9 @@ from typing import Callable, Dict, Optional, Tuple
 
 import jax.numpy as jnp
 
+import numpy as np
+
+from pinot_tpu.query import scalar
 from pinot_tpu.query.ir import Expr, ExprKind
 from pinot_tpu.segment.segment import ImmutableSegment
 
@@ -106,7 +109,81 @@ def eval_expr(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
         if dt is None:
             raise ValueError(f"unsupported CAST target {target}")
         return astype(a, dt), na
+    if op in ("least", "greatest") and expr.args:
+        vals, nulls = zip(*(eval_expr(a, segment, cols) for a in expr.args))
+        acc, nl = vals[0], nulls[0]
+        for v, n in zip(vals[1:], nulls[1:]):
+            acc = jnp.minimum(acc, v) if op == "least" else jnp.maximum(acc, v)
+            nl = _or_masks(nl, n)
+        return acc, nl
+    if op in scalar.DEVICE_FNS:
+        # one traced operand + literal parameters, in SQL order
+        # (DATETRUNC('day', ts) / ROUND(x, 2) / TIMECONVERT(t, 'SECONDS', 'DAYS'))
+        traced = [a for a in expr.args if not a.is_literal]
+        lits = [a.value for a in expr.args if a.is_literal]
+        if len(traced) != 1:
+            raise ValueError(f"{op} expects exactly one column/expression argument, got {expr}")
+        v, nv = eval_expr(traced[0], segment, cols)
+        return scalar.DEVICE_FNS[op](v if hasattr(v, "astype") else jnp.asarray(v), *lits), nv
+    if scalar.is_dict_fn_expr(expr):
+        # dictionary-domain function: host-evaluate over the dictionary's
+        # VALUES (cardinality-sized) and gather derived[codes] on device.
+        col = next(a for a in expr.args if not a.is_literal).op
+        c = segment.column(col)
+        if not c.has_dictionary:
+            raise ValueError(f"{op} requires a dictionary-encoded column ({col} is raw)")
+        if expr.op in scalar.STRING_RESULT_DICT_FNS:
+            raise ValueError(
+                f"string-valued {op}(...) never materializes on device; use it in "
+                "predicates, GROUP BY, or the select list (host paths)"
+            )
+        derived = scalar.eval_dict_fn(expr, c.dictionary.values)
+        entry = cols[col]
+        vals = jnp.asarray(derived)[entry["codes"].astype(jnp.int32)]
+        return vals, entry.get("nulls")
     raise ValueError(f"unsupported transform function {op!r} in {expr}")
+
+
+def eval_expr_host(expr: Expr, segment: ImmutableSegment, docids: np.ndarray) -> np.ndarray:
+    """Host-side expression evaluation over a SELECTED row subset (selection
+    queries gather at most offset+limit rows, so O(rows-out) host work).
+    Shares DEVICE_FNS via eager jnp; string-valued dictionary functions
+    evaluate over the dictionary and gather by code."""
+    if expr.kind is ExprKind.COLUMN:
+        return segment.column(expr.op).decoded()[docids]
+    if expr.kind is ExprKind.LITERAL:
+        return np.full(len(docids), expr.value)
+    if scalar.is_dict_fn_expr(expr):
+        col = next(a for a in expr.args if not a.is_literal).op
+        c = segment.column(col)
+        if c.has_dictionary:
+            derived = scalar.eval_dict_fn(expr, c.dictionary.values)
+            return derived[np.asarray(c.codes, dtype=np.int64)[docids]]
+    op = expr.op
+    if op in _BINARY and len(expr.args) == 2:
+        a = eval_expr_host(expr.args[0], segment, docids)
+        b = eval_expr_host(expr.args[1], segment, docids)
+        return np.asarray(_BINARY[op](jnp.asarray(a), jnp.asarray(b)))
+    if op in ("divide", "div"):
+        a = eval_expr_host(expr.args[0], segment, docids).astype(np.float64)
+        b = eval_expr_host(expr.args[1], segment, docids).astype(np.float64)
+        return a / b
+    if op in _UNARY and len(expr.args) == 1:
+        return np.asarray(_UNARY[op](jnp.asarray(eval_expr_host(expr.args[0], segment, docids))))
+    if op in scalar.DEVICE_FNS:
+        traced = [a for a in expr.args if not a.is_literal]
+        lits = [a.value for a in expr.args if a.is_literal]
+        if len(traced) == 1:
+            v = eval_expr_host(traced[0], segment, docids)
+            return np.asarray(scalar.DEVICE_FNS[op](jnp.asarray(v), *lits))
+    if op == "cast" and len(expr.args) == 2 and expr.args[1].is_literal:
+        v = eval_expr_host(expr.args[0], segment, docids)
+        target = str(expr.args[1].value).upper()
+        npdt = {"INT": np.int32, "LONG": np.int64, "FLOAT": np.float32, "DOUBLE": np.float64, "STRING": None}.get(
+            target, np.float64
+        )
+        return v.astype(str) if npdt is None else v.astype(npdt)
+    raise ValueError(f"unsupported selection expression {op!r} in {expr}")
 
 
 def astype(vals, dt):

@@ -1,0 +1,167 @@
+"""Transform/scalar function tests: datetime device kernels, dictionary-domain
+string functions, expression group-by, expression selection/filters.
+
+Datetime goldens come from python's datetime (UTC); string goldens from
+sqlite.  Reference model: DateTruncTransformFunction and the FunctionRegistry
+scalar set (pinot-common/.../function/FunctionRegistry.java:73).
+"""
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+from golden import assert_same_rows, sqlite_from_data
+
+N = 4000
+
+
+def _schema():
+    return Schema(
+        "ev",
+        [
+            FieldSpec("name", DataType.STRING),
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("price", DataType.DOUBLE, role=FieldRole.METRIC),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(23)
+    names = ["Alice Smith", "bob jones", "  pad  ", "Carol", "dave", "Eve Adams"]
+    # two years of timestamps at odd offsets
+    base = int(dt.datetime(2023, 1, 1, tzinfo=dt.timezone.utc).timestamp() * 1000)
+    return {
+        "name": rng.choice(names, N).astype(object),
+        "city": rng.choice(["sf", "NY", "tokyo"], N).astype(object),
+        "v": rng.integers(0, 1000, N),
+        "price": np.round(rng.random(N) * 100, 3),
+        "ts": base + rng.integers(0, 2 * 365 * 86400_000, N),
+    }
+
+
+@pytest.fixture(scope="module")
+def eng(data):
+    e = QueryEngine()
+    e.register_table(_schema())
+    e.add_segment("ev", build_segment(_schema(), data, "s0"))
+    return e
+
+
+@pytest.fixture(scope="module")
+def conn(data):
+    return sqlite_from_data("ev", data)
+
+
+def _py_dt(ms):
+    return dt.datetime.fromtimestamp(ms / 1000, tz=dt.timezone.utc)
+
+
+class TestDatetimeDevice:
+    def test_year_month_day_extracts(self, eng, data):
+        res = eng.query("SELECT ts, YEAR(ts), MONTH(ts), DAYOFMONTH(ts), HOUR(ts), MINUTE(ts), SECOND(ts) FROM ev LIMIT 500")
+        for row in res.rows:
+            d = _py_dt(row[0])
+            assert (row[1], row[2], row[3], row[4], row[5], row[6]) == (
+                d.year, d.month, d.day, d.hour, d.minute, d.second
+            ), f"mismatch for {d.isoformat()}"
+
+    def test_datetrunc_day_groupby(self, eng, conn):
+        sql_p = "SELECT DATETRUNC('day', ts), COUNT(*), SUM(v) FROM ev GROUP BY DATETRUNC('day', ts) ORDER BY DATETRUNC('day', ts) LIMIT 1000"
+        sql_l = "SELECT (ts/86400000)*86400000 AS d, COUNT(*), SUM(v) FROM ev GROUP BY d ORDER BY d LIMIT 1000"
+        assert_same_rows(eng.query(sql_p).rows, conn.execute(sql_l).fetchall(), ordered=True)
+
+    def test_datetrunc_month_groupby(self, eng, data):
+        res = eng.query(
+            "SELECT DATETRUNC('month', ts), COUNT(*) FROM ev GROUP BY DATETRUNC('month', ts) ORDER BY DATETRUNC('month', ts) LIMIT 100"
+        )
+        expected = {}
+        for ms in data["ts"]:
+            d = _py_dt(int(ms))
+            key = int(dt.datetime(d.year, d.month, 1, tzinfo=dt.timezone.utc).timestamp() * 1000)
+            expected[key] = expected.get(key, 0) + 1
+        got = {int(r[0]): int(r[1]) for r in res.rows}
+        assert got == expected
+
+    def test_year_groupby_expression(self, eng, conn):
+        sql_p = "SELECT YEAR(ts), COUNT(*), SUM(price) FROM ev GROUP BY YEAR(ts) ORDER BY YEAR(ts)"
+        sql_l = (
+            "SELECT CAST(strftime('%Y', ts/1000, 'unixepoch') AS INTEGER) AS y, COUNT(*), SUM(price) "
+            "FROM ev GROUP BY y ORDER BY y"
+        )
+        assert_same_rows(eng.query(sql_p).rows, conn.execute(sql_l).fetchall(), ordered=True)
+
+    def test_datetime_filter(self, eng, conn):
+        sql_p = "SELECT COUNT(*) FROM ev WHERE YEAR(ts) = 2024 AND MONTH(ts) <= 6"
+        sql_l = (
+            "SELECT COUNT(*) FROM ev WHERE CAST(strftime('%Y', ts/1000, 'unixepoch') AS INTEGER) = 2024 "
+            "AND CAST(strftime('%m', ts/1000, 'unixepoch') AS INTEGER) <= 6"
+        )
+        assert_same_rows(eng.query(sql_p).rows, conn.execute(sql_l).fetchall())
+
+    def test_timeconvert(self, eng, conn):
+        sql_p = "SELECT TIMECONVERT(ts, 'MILLISECONDS', 'DAYS'), COUNT(*) FROM ev GROUP BY TIMECONVERT(ts, 'MILLISECONDS', 'DAYS') ORDER BY TIMECONVERT(ts, 'MILLISECONDS', 'DAYS') LIMIT 1000"
+        sql_l = "SELECT ts/86400000 AS d, COUNT(*) FROM ev GROUP BY d ORDER BY d LIMIT 1000"
+        assert_same_rows(eng.query(sql_p).rows, conn.execute(sql_l).fetchall(), ordered=True)
+
+    def test_dayofweek_range(self, eng):
+        res = eng.query("SELECT DAYOFWEEK(ts), COUNT(*) FROM ev GROUP BY DAYOFWEEK(ts)")
+        dows = sorted(int(r[0]) for r in res.rows)
+        assert dows == list(range(1, 8))
+
+
+class TestStringFunctions:
+    def test_upper_lower_filter(self, eng, conn):
+        for sql in [
+            "SELECT COUNT(*) FROM ev WHERE UPPER(city) = 'SF'",
+            "SELECT COUNT(*) FROM ev WHERE LOWER(city) = 'ny'",
+        ]:
+            assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall())
+
+    def test_length_in_agg_and_filter(self, eng, conn):
+        sql = "SELECT SUM(LENGTH(name)), COUNT(*) FROM ev WHERE LENGTH(name) > 5"
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall())
+
+    def test_groupby_upper(self, eng, conn):
+        sql = "SELECT UPPER(city), COUNT(*), SUM(v) FROM ev GROUP BY UPPER(city) ORDER BY UPPER(city)"
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+    def test_selection_expressions(self, eng, conn):
+        sql_p = "SELECT UPPER(name), LENGTH(name), v * 2 FROM ev WHERE v > 995 ORDER BY v LIMIT 20"
+        sql_l = "SELECT UPPER(name), LENGTH(name), v * 2 FROM ev WHERE v > 995 ORDER BY v LIMIT 20"
+        assert_same_rows(eng.query(sql_p).rows, conn.execute(sql_l).fetchall())
+
+    def test_substr_replace_trim(self, eng, data):
+        res = eng.query("SELECT name, SUBSTR(name, 0, 3), REPLACE(name, ' ', '_'), TRIM(name) FROM ev LIMIT 50")
+        for row in res.rows:
+            assert row[1] == row[0][0:3]
+            assert row[2] == row[0].replace(" ", "_")
+            assert row[3] == row[0].strip()
+
+    def test_startswith_contains(self, eng, conn):
+        sql_p = "SELECT COUNT(*) FROM ev WHERE STARTSWITH(name, 'A') = 1"
+        sql_l = "SELECT COUNT(*) FROM ev WHERE name LIKE 'A%'"
+        assert_same_rows(eng.query(sql_p).rows, conn.execute(sql_l).fetchall())
+
+
+class TestNumericExpressions:
+    def test_round_and_arith_selection(self, eng, conn):
+        sql = "SELECT v, ROUND(price, 1) FROM ev WHERE v > 990 ORDER BY v LIMIT 30"
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall())
+
+    def test_mod_groupby(self, eng, conn):
+        sql_p = "SELECT MOD(v, 7), COUNT(*) FROM ev GROUP BY MOD(v, 7) ORDER BY MOD(v, 7)"
+        sql_l = "SELECT v % 7 AS m, COUNT(*) FROM ev GROUP BY m ORDER BY m"
+        assert_same_rows(eng.query(sql_p).rows, conn.execute(sql_l).fetchall(), ordered=True)
+
+    def test_arith_expression_groupby(self, eng, conn):
+        sql_p = "SELECT v - MOD(v, 100), COUNT(*) FROM ev GROUP BY v - MOD(v, 100) ORDER BY v - MOD(v, 100)"
+        sql_l = "SELECT (v/100)*100 AS b, COUNT(*) FROM ev GROUP BY b ORDER BY b"
+        assert_same_rows(eng.query(sql_p).rows, conn.execute(sql_l).fetchall(), ordered=True)

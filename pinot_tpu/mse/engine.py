@@ -400,6 +400,10 @@ class MultiStageEngine:
             raise NotImplementedError("selection (non-aggregate) queries over joins are unsupported")
 
         planner_mod.guard_sparse_vector_fields(kind, aggs)
+        if any(fn.pairwise_merge for fn in aggs):
+            raise NotImplementedError(
+                "pairwise-merge aggregations cannot ride the in-graph psum combine"
+            )
         vranges = planner_mod.agg_vranges(agg_specs, fact_st)
 
         # -- needed columns ----------------------------------------------

@@ -94,6 +94,14 @@ def make_agg_inputs(agg_specs, aggs, agg_filter_fns, view, table_like, null_hand
                 vals = as_row_array(vals, mask.shape)
                 if nulls is not None and null_handling:
                     mask = mask & ~nulls
+            if fn.needs_extra_exprs:
+                extras = []
+                for ex in spec.extra_exprs:
+                    ev, en = eval_expr(ex, view, cols)
+                    extras.append(as_row_array(ev, mask.shape))
+                    if en is not None and null_handling:
+                        mask = mask & ~en
+                vals = (vals, *extras)
             out.append((vals, mask))
         return out
 
@@ -257,6 +265,11 @@ class DistributedEngine:
             num_groups = 0
 
         planner_mod.guard_sparse_vector_fields(kind, aggs)
+        if any(fn.pairwise_merge for fn in aggs):
+            raise NotImplementedError(
+                "pairwise-merge aggregations (FIRST/LAST_WITH_TIME, DISTINCTCOUNTTHETA) "
+                "cannot ride the in-graph psum combine; run them on the single-node engine"
+            )
 
         null_handling = ctx.null_handling
         _flat = flatten_cols

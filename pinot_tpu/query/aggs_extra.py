@@ -1,0 +1,321 @@
+"""Aggregation breadth: log-bucket percentile sketch, theta distinct count,
+MODE, FIRST/LAST_WITH_TIME.
+
+Reference parity: pinot-core/.../query/aggregation/function/
+PercentileKLLAggregationFunction, DistinctCountThetaSketchAggregationFunction,
+ModeAggregationFunction, FirstWithTimeAggregationFunction /
+LastWithTimeAggregationFunction.
+
+Re-designs (TPU-first):
+  * PERCENTILEKLL -> a DDSketch-style LOG-BUCKETED histogram: bucket =
+    floor(log_gamma(|v|)) with mirrored negative buckets and a zero bucket.
+    Fixed-size additive tensor partial (dense-mergeable, psum-able — which
+    the reference's KLL bytes are not), guaranteed RELATIVE value error
+    alpha on any skewed/unbounded range — exactly where the equi-width
+    histogram of query/sketches.py fails.  (Error contract differs from
+    KLL's rank-error; documented.)
+  * DISTINCTCOUNTTHETA -> KMV/theta: the K smallest distinct 63-bit row
+    hashes, computed on device with the same sort + cumsum-compaction trick
+    as the sparse group-by; fixed [K] partial, pairwise host merge.
+  * MODE -> value-offset histogram (like exact DISTINCTCOUNT's bounded-range
+    form) + argmax at final; additive fields make it fully generic.
+  * FIRST/LAST_WITH_TIME -> per-segment argmin/argmax over the time column
+    (a second expression argument — AggregationSpec.extra_exprs), scatter
+    min/max per group; partials carry (t, v) and merge pairwise by time.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu import ops
+from pinot_tpu.query.functions import AggFunction, register
+from pinot_tpu.query.sketches import ColumnBinding, _check_cell_budget
+
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+
+
+# ---------------------------------------------------------------------------
+# PERCENTILEKLL: log-bucketed (DDSketch-style) quantile histogram
+# ---------------------------------------------------------------------------
+class PercentileLogSketchFunction(AggFunction):
+    name = "percentilekll"
+    vector_fields = True
+    fields = ("hist",)
+
+    # magnitude contract: values with |v| in [MIN_MAG, MAX_MAG] keep the
+    # relative-error bound; smaller collapse into the zero bucket, larger
+    # clamp into the top bucket.
+    MIN_MAG = 1e-9
+    MAX_MAG = 1e12
+
+    def __init__(self, rank: float = 50.0, alpha: float = 0.01):
+        self.rank = float(rank)
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + alpha) / (1.0 - alpha)
+        self.lg = math.log(self.gamma)
+        # buckets per sign covering [MIN_MAG, MAX_MAG]
+        self.bins = int(math.ceil(math.log(self.MAX_MAG / self.MIN_MAG) / self.lg)) + 1
+        self.min_idx = int(math.floor(math.log(self.MIN_MAG) / self.lg))
+        self.width = 2 * self.bins + 1  # neg | zero | pos
+
+    def with_args(self, literal_args):
+        rank = float(literal_args[0]) if literal_args else 50.0
+        # 2nd literal: Pinot's kllSize K; mapped to alpha = 2/K (K=200 -> 1%)
+        alpha = 2.0 / float(literal_args[1]) if len(literal_args) > 1 else 0.01
+        return PercentileLogSketchFunction(rank, alpha)
+
+    def _bucket(self, values):
+        import jax.numpy as jnp
+
+        v = values.astype(jnp.float64)
+        av = jnp.abs(v)
+        safe = jnp.maximum(av, self.MIN_MAG)
+        idx = jnp.clip(
+            (jnp.log(safe) / self.lg).astype(jnp.int32) - np.int32(self.min_idx),
+            0,
+            self.bins - 1,
+        )
+        center = np.int32(self.bins)
+        b = jnp.where(av < self.MIN_MAG, center, jnp.where(v > 0, center + 1 + idx, center - 1 - idx))
+        return b
+
+    def partial(self, values, mask):
+        b = self._bucket(values)
+        return {"hist": ops.group_count(mask, b, self.width)}
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        _check_cell_budget(self.name, num_groups, self.width)
+        b = self._bucket(values)
+        flat = keys * np.int32(self.width) + b
+        return {"hist": ops.group_count(mask, flat, num_groups * self.width).reshape(num_groups, self.width)}
+
+    def merge(self, a, b):
+        return {"hist": np.asarray(a["hist"]) + np.asarray(b["hist"])}
+
+    def _bucket_value(self, g: int) -> float:
+        """Representative value of global bucket g (midpoint in log space)."""
+        center = self.bins
+        if g == center:
+            return 0.0
+        i = abs(g - center) - 1
+        mag = math.exp((i + self.min_idx) * self.lg) * (2.0 * self.gamma / (self.gamma + 1.0))
+        return mag if g > center else -mag
+
+    def final(self, p):
+        hist = np.atleast_2d(np.asarray(p["hist"], dtype=np.float64))
+        n_groups = hist.shape[0]
+        out = np.full(n_groups, np.nan)
+        for g in range(n_groups):
+            total = hist[g].sum()
+            if total == 0:
+                continue
+            target = self.rank / 100.0 * total
+            cum = np.cumsum(hist[g])
+            idx = min(int(np.searchsorted(cum, target, side="left")), self.width - 1)
+            out[g] = self._bucket_value(idx)
+        return out[0] if np.asarray(p["hist"]).ndim == 1 else out
+
+
+# ---------------------------------------------------------------------------
+# DISTINCTCOUNTTHETA: KMV sketch (K smallest distinct hashes)
+# ---------------------------------------------------------------------------
+class DistinctCountThetaFunction(AggFunction):
+    name = "distinctcounttheta"
+    needs_codes = True
+    needs_binding = True
+    vector_fields = True
+    pairwise_merge = True
+    input_kind = "values_hash"
+    fields = ("kmv",)
+
+    K = 4096
+
+    def bind_column(self, info: ColumnBinding) -> "DistinctCountThetaFunction":
+        return self  # hash-based: no per-column constants
+
+    def partial(self, values, mask):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from pinot_tpu.query.sketches import _device_hash32, _device_hash_values
+
+        h1 = _device_hash_values(values)
+        h2 = _device_hash32(h1 ^ np.uint32(0x9E3779B9))
+        # clean 62-bit hash in [0, 2^62): h1 -> bits 31..61, h2 -> bits 0..30
+        # (positive int64, so int64 sort order == unsigned order)
+        h = ((h1 & np.uint32(0x7FFFFFFF)).astype(jnp.int64) << np.int64(31)) | (
+            h2 >> np.uint32(1)
+        ).astype(jnp.int64)
+        h = jnp.where(mask, h, _I64_MAX)
+        s = lax.sort(h)
+        prev = jnp.concatenate([jnp.full((1,), -1, s.dtype), s[:-1]])
+        is_new = (s != prev) & (s != _I64_MAX)
+        idx = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+        k = min(self.K, s.shape[0])
+        slot = jnp.where(is_new & (idx < k), idx, k)
+        kmv = jnp.full((k + 1,), _I64_MAX, dtype=jnp.int64).at[slot].set(s)[:k]
+        return {"kmv": kmv}
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        raise NotImplementedError(
+            "DISTINCTCOUNTTHETA does not support GROUP BY (per-group K-min sets); "
+            "use DISTINCTCOUNTHLL or exact DISTINCTCOUNT"
+        )
+
+    def merge(self, a, b):
+        u = np.unique(np.concatenate([np.asarray(a["kmv"]), np.asarray(b["kmv"])]))
+        u = u[u != _I64_MAX][: self.K]
+        if len(u) < self.K:
+            u = np.concatenate([u, np.full(self.K - len(u), _I64_MAX, dtype=np.int64)])
+        return {"kmv": u}
+
+    def final(self, p):
+        kmv = np.asarray(p["kmv"])
+        valid = kmv[kmv != _I64_MAX]
+        n = len(valid)
+        if n < min(self.K, max(1, len(kmv))):
+            return n  # fewer distincts than K: exact
+        theta = float(valid[-1]) / float(1 << 62)  # kth smallest / max-hash
+        return (n - 1) / theta if theta > 0 else n
+
+    def final_dtype(self):
+        return np.dtype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# MODE: value-offset histogram + argmax
+# ---------------------------------------------------------------------------
+class ModeFunction(AggFunction):
+    """Most frequent value over a bounded int range; ties break to the
+    SMALLEST value (Pinot's default MIN reducer)."""
+
+    name = "mode"
+    needs_codes = True
+    needs_binding = True
+    vector_fields = True
+    input_kind = "values_offset"
+    fields = ("hist", "lo")
+
+    def __init__(self, domain: int = 0, base: int = 0):
+        self.domain = domain
+        self.base = base
+
+    def bind_column(self, info: ColumnBinding) -> "ModeFunction":
+        if info.kind == "rawint" or (
+            info.min_value is not None
+            and isinstance(info.min_value, (int, np.integer))
+            and isinstance(info.max_value, (int, np.integer))
+        ):
+            base = int(info.min_value)
+            domain = int(info.max_value) - base + 1
+            return ModeFunction(domain=domain, base=base)
+        raise NotImplementedError(
+            "MODE requires a bounded integer value range (int/long column with stats)"
+        )
+
+    def partial(self, codes, mask):
+        import jax.numpy as jnp
+
+        _check_cell_budget(self.name, 1, self.domain)
+        hist = ops.group_count(mask, codes, self.domain)
+        return {"hist": hist, "lo": jnp.asarray(float(self.base))}
+
+    def partial_grouped(self, codes, mask, keys, num_groups):
+        import jax.numpy as jnp
+
+        _check_cell_budget(self.name, num_groups, self.domain)
+        flat = keys * np.int32(self.domain) + codes
+        hist = ops.group_count(mask, flat, num_groups * self.domain).reshape(num_groups, self.domain)
+        return {"hist": hist, "lo": jnp.full((num_groups,), float(self.base))}
+
+    def merge(self, a, b):
+        return {"hist": np.asarray(a["hist"]) + np.asarray(b["hist"]), "lo": np.minimum(a["lo"], b["lo"])}
+
+    def final(self, p):
+        hist = np.atleast_2d(np.asarray(p["hist"]))
+        lo = np.atleast_1d(np.asarray(p["lo"], dtype=np.float64))
+        # np.argmax takes the FIRST max — the lowest offset = smallest value
+        best = np.argmax(hist, axis=1).astype(np.float64)
+        out = np.where(hist.sum(axis=1) > 0, lo + best, np.nan)
+        return out[0] if np.asarray(p["hist"]).ndim == 1 else out
+
+
+# ---------------------------------------------------------------------------
+# FIRST/LAST_WITH_TIME(value, timeCol, 'dataType')
+# ---------------------------------------------------------------------------
+class LastWithTimeFunction(AggFunction):
+    """Value at the max (LAST) / min (FIRST) time.  values arrives as the
+    tuple (v, t) via AggregationSpec.extra_exprs; ties on t take the max v
+    (deterministic).  Partials merge pairwise by time comparison."""
+
+    name = "lastwithtime"
+    needs_extra_exprs = True
+    vector_fields = True  # keep off the generic sparse/psum field paths
+    pairwise_merge = True
+    fields = ("t", "v")
+    pick_last = True
+
+    def _prep(self, values, mask):
+        import jax.numpy as jnp
+
+        v, t = values[0], values[1]
+        sign = 1.0 if self.pick_last else -1.0
+        # maximize sign*t; track v among time-ties via a second scatter
+        tt = jnp.where(mask, t.astype(jnp.float64) * sign, -jnp.inf)
+        return v.astype(jnp.float64), tt, sign
+
+    def partial(self, values, mask):
+        import jax.numpy as jnp
+
+        v, tt, sign = self._prep(values, mask)
+        tmax = jnp.max(tt)
+        best = mask & (tt == tmax)
+        vbest = jnp.max(jnp.where(best, v, -jnp.inf))
+        return {"t": tmax * sign, "v": vbest}
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        import jax.numpy as jnp
+
+        v, tt, sign = self._prep(values, mask)
+        k = keys.astype(jnp.int32)
+        tmax = jnp.full((num_groups,), -jnp.inf).at[k].max(jnp.where(mask, tt, -jnp.inf), mode="drop")
+        best = mask & (tt == tmax[k])
+        vbest = jnp.full((num_groups,), -jnp.inf).at[k].max(jnp.where(best, v, -jnp.inf), mode="drop")
+        return {"t": tmax * sign, "v": vbest}
+
+    def merge(self, a, b):
+        sign = 1.0 if self.pick_last else -1.0
+        at, bt = np.asarray(a["t"], np.float64) * sign, np.asarray(b["t"], np.float64) * sign
+        av, bv = np.asarray(a["v"], np.float64), np.asarray(b["v"], np.float64)
+        take_b = (bt > at) | ((bt == at) & (bv > av))
+        return {"t": np.where(take_b, b["t"], a["t"]), "v": np.where(take_b, bv, av)}
+
+    def final(self, p):
+        v = np.asarray(p["v"], dtype=np.float64)
+        t = np.asarray(p["t"], dtype=np.float64)
+        return np.where(np.isfinite(t), v, np.nan)
+
+
+class FirstWithTimeFunction(LastWithTimeFunction):
+    name = "firstwithtime"
+    pick_last = False
+
+
+_EXTRA = (
+    PercentileLogSketchFunction,
+    DistinctCountThetaFunction,
+    ModeFunction,
+    LastWithTimeFunction,
+    FirstWithTimeFunction,
+)
+for _cls in _EXTRA:
+    register(_cls())
+
+# aliases matching the reference's surface
+from pinot_tpu.query.functions import _REGISTRY  # noqa: E402
+
+_REGISTRY["distinctcountrawtheta"] = _REGISTRY["distinctcounttheta"]
+_REGISTRY["distinctcountbitmap"] = _REGISTRY["distinctcount"]

@@ -73,8 +73,15 @@ class AggFunction:
     # planner must call bind_column() with per-column constants before use
     needs_binding: bool = False
     # partial fields are per-group VECTORS (presence/registers/histograms);
-    # such aggs cannot ride the scalar-field host sparse-groupby fallback
+    # such aggs cannot ride the scalar-field sparse group-by kernel
     vector_fields: bool = False
+    # partials merge ONLY via pairwise fn.merge (fields are coupled, e.g.
+    # LASTWITHTIME's (t, v) or theta's kmv set) — the field-name elementwise
+    # combines and in-graph psum paths must not touch them
+    pairwise_merge: bool = False
+    # spec.extra_exprs evaluate alongside expr; partial() receives the tuple
+    # (values, extra0, ...) instead of a single array
+    needs_extra_exprs: bool = False
     # field -> entry kind ("count"|"sum"|"sumsq"|"min"|"max") for the fused
     # dense group-by scan (ops.fused_group_tables); None = the function's own
     # partial_grouped runs instead (sketch family)
@@ -369,3 +376,7 @@ def for_spec(spec) -> AggFunction:
 
 # Register the sketch family (import at bottom: sketches subclasses AggFunction)
 from pinot_tpu.query import sketches  # noqa: E402,F401
+
+# Extended aggregations (KLL log-sketch, theta, MODE, FIRST/LAST_WITH_TIME);
+# must import AFTER sketches: percentilekll overrides the histogram stand-in
+from pinot_tpu.query import aggs_extra  # noqa: E402,F401

@@ -225,11 +225,14 @@ class AggregationSpec:
     filter: Optional[FilterNode] = None
     # extra literal args, e.g. percentile rank, HLL log2m
     literal_args: Tuple[Any, ...] = ()
+    # extra EXPRESSION args beyond the first (LASTWITHTIME's time column)
+    extra_exprs: Tuple[Expr, ...] = ()
 
     def fingerprint(self) -> str:
         e = self.expr.fingerprint() if self.expr else "*"
         f = self.filter.fingerprint() if self.filter else ""
-        return f"{self.function}({e})[{f}]{self.literal_args!r}"
+        x = "|".join(a.fingerprint() for a in self.extra_exprs)
+        return f"{self.function}({e};{x})[{f}]{self.literal_args!r}"
 
     def __str__(self) -> str:
         return f"{self.function}({self.expr if self.expr else '*'})"

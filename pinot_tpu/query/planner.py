@@ -272,6 +272,8 @@ def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
         if isinstance(s, AggregationSpec):
             if s.expr is not None:
                 cols.extend(s.expr.columns())
+            for ex in s.extra_exprs:
+                cols.extend(ex.columns())
             if s.filter:
                 cols.extend(s.filter.columns())
         else:
@@ -743,6 +745,14 @@ def _build_plan(
                 vals = as_row_array(vals, mask.shape)
                 if nulls is not None and null_handling:
                     mask = mask & ~nulls
+            if fn.needs_extra_exprs:
+                extras = []
+                for ex in spec.extra_exprs:
+                    ev, en = eval_expr(ex, segment, cols)
+                    extras.append(as_row_array(ev, mask.shape))
+                    if en is not None and null_handling:
+                        mask = mask & ~en
+                vals = (vals, *extras)
             out.append((vals, mask))
         return out
 

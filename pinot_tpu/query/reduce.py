@@ -95,11 +95,21 @@ def _reduce_groupby(ctx: QueryContext, results: List[GroupBySegmentResult], stat
         d0 = results[0].dense
         presence = np.zeros_like(d0.presence)
         merged_partials = [
-            {f: np.full_like(arr, _ident_like(f, arr)) for f, arr in p.items()} for p in d0.partials
+            {f: np.full_like(arr, _ident_like(f, arr)) for f, arr in p.items()}
+            if not fn.pairwise_merge
+            else None
+            for fn, p in zip(aggs, d0.partials)
         ]
         for r in results:
             presence = presence + r.dense.presence
-            for mp, p in zip(merged_partials, r.dense.partials):
+            for ai, (fn, p) in enumerate(zip(aggs, r.dense.partials)):
+                if fn.pairwise_merge:
+                    # coupled fields (LASTWITHTIME's (t, v)): elementwise
+                    # fn.merge over the whole dense table, not per-field
+                    cur = merged_partials[ai]
+                    merged_partials[ai] = p if cur is None else fn.merge(cur, p)
+                    continue
+                mp = merged_partials[ai]
                 for f in mp:
                     mp[f] = combine_field(f, mp[f], np.asarray(p[f]))
         present = np.nonzero(presence > 0)[0]

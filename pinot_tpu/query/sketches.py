@@ -491,10 +491,12 @@ class PercentileFunction(AggFunction):
         return out[0] if scalar else out
 
 
-# The Est/TDigest/KLL names resolve to the same mergeable histogram sketch;
+# The Est/TDigest names resolve to the same mergeable histogram sketch;
 # accuracy contract is (hi-lo)/bins instead of the reference's per-sketch
 # bounds (documented delta — the partials remain mergeable across segments
 # and psum-combinable across chips, which the reference's sketches are not).
+# PERCENTILEKLL lives in aggs_extra.py as a log-bucketed sketch with a
+# relative-error bound on unbounded/skewed ranges.
 class PercentileEstFunction(PercentileFunction):
     name = "percentileest"
 
@@ -503,17 +505,12 @@ class PercentileTDigestFunction(PercentileFunction):
     name = "percentiletdigest"
 
 
-class PercentileKLLFunction(PercentileFunction):
-    name = "percentilekll"
-
-
 for _cls in (
     DistinctCountFunction,
     DistinctCountHLLFunction,
     PercentileFunction,
     PercentileEstFunction,
     PercentileTDigestFunction,
-    PercentileKLLFunction,
 ):
     register(_cls())
 

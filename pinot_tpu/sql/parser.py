@@ -471,7 +471,8 @@ class _Parser:
             return AggregationSpec("count", None)
         expr = args[0] if args else None
         lits = tuple(a.value for a in args[1:] if a.is_literal)
-        return AggregationSpec(e.op, expr, literal_args=lits)
+        extra = tuple(a for a in args[1:] if not a.is_literal)
+        return AggregationSpec(e.op, expr, literal_args=lits, extra_exprs=extra)
 
     # -- boolean (filter) grammar ---------------------------------------
     def boolean_expr(self) -> FilterNode:

@@ -1,0 +1,164 @@
+"""Extended aggregation tests: log-sketch percentile on heavy tails, theta
+distinct count, MODE, FIRST/LAST_WITH_TIME.
+
+Reference model: PercentileKLLAggregationFunction (error-bounded quantiles
+on skewed data), DistinctCountThetaSketchAggregationFunction,
+ModeAggregationFunction, Last/FirstWithTimeAggregationFunction.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+N = 50_000
+
+
+def _make_engine(data, schema, n_segments=3):
+    eng = QueryEngine()
+    eng.register_table(schema)
+    n = len(next(iter(data.values())))
+    bounds = np.linspace(0, n, n_segments + 1).astype(int)
+    for i in range(n_segments):
+        chunk = {k: v[bounds[i] : bounds[i + 1]] for k, v in data.items()}
+        eng.add_segment(schema.name, build_segment(schema, chunk, f"s{i}"))
+    return eng
+
+
+class TestLogSketchPercentile:
+    @pytest.fixture(scope="class")
+    def heavy(self):
+        rng = np.random.default_rng(31)
+        # lognormal with sigma=3: spans ~9 orders of magnitude; an equi-width
+        # histogram puts essentially all mass in bin 0
+        vals = rng.lognormal(mean=2.0, sigma=3.0, size=N)
+        schema = Schema("h", [FieldSpec("v", DataType.DOUBLE, role=FieldRole.METRIC)])
+        return _make_engine({"v": vals}, schema), vals
+
+    @pytest.mark.parametrize("rank", [50, 95, 99])
+    def test_relative_error_on_heavy_tail(self, heavy, rank):
+        eng, vals = heavy
+        res = eng.query(f"SELECT PERCENTILEKLL(v, {rank}) FROM h")
+        got = float(res.rows[0][0])
+        true = float(np.percentile(vals, rank))
+        rel = abs(got - true) / true
+        assert rel < 0.03, f"p{rank}: got {got}, true {true}, rel err {rel:.4f}"
+
+    def test_histogram_standin_fails_where_logsketch_works(self, heavy):
+        """The round-2 equi-width histogram visibly fails on this data —
+        the finding that motivated the real sketch (VERDICT r2 #9)."""
+        eng, vals = heavy
+        true = float(np.percentile(vals, 50))
+        hist = float(eng.query("SELECT PERCENTILETDIGEST(v, 50) FROM h").rows[0][0])
+        log = float(eng.query("SELECT PERCENTILEKLL(v, 50) FROM h").rows[0][0])
+        assert abs(log - true) / true < 0.03
+        assert abs(hist - true) / true > 0.5  # equi-width is off by >50% here
+
+    def test_negative_and_zero_values(self):
+        rng = np.random.default_rng(5)
+        vals = np.concatenate([-rng.lognormal(1, 2, 20000), np.zeros(1000), rng.lognormal(1, 2, 20000)])
+        schema = Schema("m", [FieldSpec("v", DataType.DOUBLE, role=FieldRole.METRIC)])
+        eng = _make_engine({"v": vals}, schema)
+        for rank in (10, 50, 90):
+            got = float(eng.query(f"SELECT PERCENTILEKLL(v, {rank}) FROM m").rows[0][0])
+            true = float(np.percentile(vals, rank))
+            denom = max(abs(true), 1e-9)
+            assert abs(got - true) / denom < 0.05, (rank, got, true)
+
+    def test_grouped_log_sketch(self):
+        rng = np.random.default_rng(7)
+        g = rng.integers(0, 4, 20000)
+        vals = rng.lognormal(mean=g.astype(float), sigma=2.0)
+        schema = Schema(
+            "gg",
+            [FieldSpec("g", DataType.INT), FieldSpec("v", DataType.DOUBLE, role=FieldRole.METRIC)],
+        )
+        eng = _make_engine({"g": g, "v": vals}, schema)
+        res = eng.query("SELECT g, PERCENTILEKLL(v, 50) FROM gg GROUP BY g ORDER BY g")
+        for row in res.rows:
+            true = float(np.percentile(vals[g == int(row[0])], 50))
+            assert abs(float(row[1]) - true) / true < 0.03
+
+
+class TestTheta:
+    def test_exact_below_k(self):
+        rng = np.random.default_rng(11)
+        vals = rng.integers(0, 1000, N)  # 1000 distinct < K=4096
+        schema = Schema("t", [FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)])
+        eng = _make_engine({"v": vals}, schema)
+        got = int(eng.query("SELECT DISTINCTCOUNTTHETA(v) FROM t").rows[0][0])
+        assert got == len(np.unique(vals))
+
+    def test_estimate_above_k(self):
+        rng = np.random.default_rng(13)
+        vals = rng.integers(0, 40_000, 200_000)
+        true = len(np.unique(vals))
+        schema = Schema("t", [FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)])
+        eng = _make_engine({"v": vals}, schema, n_segments=4)
+        got = float(eng.query("SELECT DISTINCTCOUNTTHETA(v) FROM t").rows[0][0])
+        assert abs(got - true) / true < 0.05, (got, true)
+
+
+class TestMode:
+    def test_mode_scalar_and_grouped(self):
+        rng = np.random.default_rng(17)
+        g = rng.integers(0, 3, 30000)
+        # per-group biased distribution: mode of group i is i*10
+        v = np.where(rng.random(30000) < 0.4, g * 10, rng.integers(0, 100, 30000))
+        schema = Schema(
+            "mo",
+            [FieldSpec("g", DataType.INT), FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)],
+        )
+        eng = _make_engine({"g": g, "v": v}, schema)
+        res = eng.query("SELECT g, MODE(v) FROM mo GROUP BY g ORDER BY g")
+        for row in res.rows:
+            vg = v[g == int(row[0])]
+            counts = np.bincount(vg)
+            expected = counts.argmax()  # ties -> smallest, same as MODE
+            assert float(row[1]) == float(expected)
+        scalar = eng.query("SELECT MODE(v) FROM mo").rows[0][0]
+        assert float(scalar) == float(np.bincount(v).argmax())
+
+
+class TestFirstLastWithTime:
+    @pytest.fixture(scope="class")
+    def env(self):
+        rng = np.random.default_rng(19)
+        n = 20000
+        g = rng.integers(0, 5, n)
+        t = rng.permutation(n).astype(np.int64) + 1_000_000
+        v = rng.integers(0, 10_000, n)
+        schema = Schema(
+            "lt",
+            [
+                FieldSpec("g", DataType.INT),
+                FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("t", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+            ],
+        )
+        return _make_engine({"g": g, "v": v, "t": t}, schema), g, t, v
+
+    def test_last_with_time_scalar(self, env):
+        eng, g, t, v = env
+        got = eng.query("SELECT LASTWITHTIME(v, t, 'LONG') FROM lt").rows[0][0]
+        assert float(got) == float(v[np.argmax(t)])
+
+    def test_first_with_time_scalar(self, env):
+        eng, g, t, v = env
+        got = eng.query("SELECT FIRSTWITHTIME(v, t, 'LONG') FROM lt").rows[0][0]
+        assert float(got) == float(v[np.argmin(t)])
+
+    def test_last_with_time_grouped(self, env):
+        eng, g, t, v = env
+        res = eng.query("SELECT g, LASTWITHTIME(v, t, 'LONG'), FIRSTWITHTIME(v, t, 'LONG') FROM lt GROUP BY g ORDER BY g")
+        for row in res.rows:
+            m = g == int(row[0])
+            assert float(row[1]) == float(v[m][np.argmax(t[m])])
+            assert float(row[2]) == float(v[m][np.argmin(t[m])])
+
+    def test_last_with_filter(self, env):
+        eng, g, t, v = env
+        got = eng.query("SELECT LASTWITHTIME(v, t, 'LONG') FROM lt WHERE g = 2").rows[0][0]
+        m = g == 2
+        assert float(got) == float(v[m][np.argmax(t[m])])

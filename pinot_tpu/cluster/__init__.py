@@ -1,0 +1,25 @@
+"""Cluster layer: coordinator (controller), servers, broker routing.
+
+Reference parity map (SURVEY.md §2.3, L6):
+  coordinator.py - PinotHelixResourceManager (table CRUD :2045, addNewSegment
+                   :3037 -> assignSegment :3056), segment assignment
+                   strategies, TableRebalancer.rebalance (:201), periodic
+                   tasks (RetentionManager, SegmentStatusChecker)
+  server.py      - ServerInstance / HelixInstanceDataManager: per-server
+                   segment ownership + local query execution
+  broker.py      - BrokerRoutingManager (:33) routing tables, instance
+                   selectors (balanced / replica-group), segment pruners
+                   (partition, time), BaseSingleStageBrokerRequestHandler
+
+Re-design: no Helix/ZooKeeper — a single-process coordinator owns the
+metadata maps the reference keeps in ZK (ideal state / external view), and
+"servers" are logical workers that pin their segment sets to device memory.
+State transitions are direct method calls instead of Helix messages; the
+CONTRACTS (replication, min-available-replicas rebalance, routing
+consistency) match the reference.
+"""
+from pinot_tpu.cluster.coordinator import Coordinator
+from pinot_tpu.cluster.server import ServerInstance
+from pinot_tpu.cluster.broker import Broker
+
+__all__ = ["Coordinator", "ServerInstance", "Broker"]

@@ -1,0 +1,220 @@
+"""Coordinator: table/segment metadata, assignment, rebalance, retention.
+
+Reference parity: PinotHelixResourceManager (pinot-controller/.../helix/core/
+PinotHelixResourceManager.java — addTable :2045, addNewSegment :3037 ->
+assignSegment :3056), assignment strategies (.../core/assignment/segment/),
+TableRebalancer.rebalance (.../rebalance/TableRebalancer.java:201, contract
+:122-134: never drop below min-available replicas), RetentionManager and
+SegmentStatusChecker periodic tasks.
+
+Re-design: ideal state / external view are plain dicts owned by this object
+(the ZK-free control plane of SURVEY.md §2.6); servers register directly.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from pinot_tpu.segment.segment import ImmutableSegment
+from pinot_tpu.spi.config import TableConfig
+from pinot_tpu.spi.schema import Schema
+
+
+@dataclass
+class TableMeta:
+    schema: Schema
+    config: TableConfig
+    # ideal state: segment name -> set of server names that SHOULD serve it
+    ideal: Dict[str, Set[str]] = field(default_factory=dict)
+    # segment metadata the broker prunes on (time range, partition, docs)
+    segment_meta: Dict[str, Dict] = field(default_factory=dict)
+
+
+class Coordinator:
+    def __init__(self, replication: int = 1):
+        self.replication = replication
+        self.tables: Dict[str, TableMeta] = {}
+        self.servers: Dict[str, "ServerInstance"] = {}  # noqa: F821
+        self.live: Set[str] = set()
+        # replica-group membership: server -> group id (round-robin on join)
+        self.replica_group: Dict[str, int] = {}
+        self.num_replica_groups = max(1, replication)
+
+    # -- instance lifecycle (Helix participant analog) -------------------
+    def register_server(self, server) -> None:
+        self.servers[server.name] = server
+        self.live.add(server.name)
+        self.replica_group[server.name] = len(self.replica_group) % self.num_replica_groups
+
+    def mark_down(self, name: str) -> None:
+        """Liveness loss (Helix session expiry analog): external view drops
+        the server; ideal state keeps it until rebalance repairs."""
+        self.live.discard(name)
+
+    def mark_up(self, name: str) -> None:
+        if name in self.servers:
+            self.live.add(name)
+
+    # -- table CRUD ------------------------------------------------------
+    def add_table(self, schema: Schema, config: Optional[TableConfig] = None) -> None:
+        cfg = config or TableConfig(name=schema.name)
+        if cfg.name in self.tables:
+            raise ValueError(f"table {cfg.name} already exists")
+        self.tables[cfg.name] = TableMeta(schema=schema, config=cfg)
+
+    def drop_table(self, name: str) -> None:
+        meta = self.tables.pop(name)
+        for seg_name, servers in meta.ideal.items():
+            for s in servers:
+                if s in self.servers:
+                    self.servers[s].drop_segment(name, seg_name)
+
+    # -- segment registration + assignment -------------------------------
+    def add_segment(self, table: str, segment: ImmutableSegment) -> List[str]:
+        """addNewSegment -> assignSegment -> server state transitions."""
+        meta = self.tables[table]
+        targets = self._assign(meta, segment.name)
+        meta.ideal[segment.name] = set(targets)
+        meta.segment_meta[segment.name] = self._seg_meta(segment)
+        for s in targets:
+            self.servers[s].add_segment(table, segment)
+        return targets
+
+    def _seg_meta(self, segment: ImmutableSegment) -> Dict:
+        part = None
+        for c in segment.columns.values():
+            if c.stats.partition_id is not None:
+                part = (c.name, c.stats.partition_id, c.stats.num_partitions)
+        # per-column stats for broker-side range injection (ZK segment
+        # metadata analog: the broker never touches segment data)
+        col_stats = {}
+        for c in segment.columns.values():
+            col_stats[c.name] = {
+                "min": c.stats.min_value,
+                "max": c.stats.max_value,
+                "dictFp": c.dictionary.fingerprint() if c.has_dictionary else None,
+            }
+        return {
+            "numDocs": segment.num_docs,
+            "timeRange": segment.time_range,
+            "partition": part,
+            "creationTimeMs": segment.creation_time_ms,
+            "colStats": col_stats,
+        }
+
+    def _assign(self, meta: TableMeta, seg_name: str) -> List[str]:
+        """Replica-group aware balanced placement: one server per replica
+        group (replication R = R groups), least-loaded within the group."""
+        if not self.live:
+            raise RuntimeError("no live servers to assign to")
+        loads = {s: 0 for s in self.live}
+        for segs in meta.ideal.values():
+            for s in segs:
+                if s in loads:
+                    loads[s] += 1
+        out: List[str] = []
+        for g in range(self.num_replica_groups):
+            members = [s for s in self.live if self.replica_group[s] == g]
+            if not members:
+                continue
+            out.append(min(members, key=lambda s: (loads[s], s)))
+        # a replica group with zero live members can't host its copy — top up
+        # replication from the remaining live servers (availability over
+        # strict group placement, like the reference's non-strict fallback)
+        want = min(self.replication, len(self.live))
+        remaining = [s for s in self.live if s not in out]
+        while len(out) < want and remaining:
+            pick = min(remaining, key=lambda s: (loads[s], s))
+            remaining.remove(pick)
+            out.append(pick)
+        return out
+
+    # -- views -----------------------------------------------------------
+    def external_view(self, table: str) -> Dict[str, Set[str]]:
+        """Ideal state filtered to LIVE servers — what the broker routes on
+        (ExternalView analog)."""
+        meta = self.tables[table]
+        return {seg: {s for s in servers if s in self.live} for seg, servers in meta.ideal.items()}
+
+    # -- rebalance --------------------------------------------------------
+    def rebalance(self, table: str, min_available_replicas: int = 1) -> Dict[str, int]:
+        """Repair/redistribute assignment over the CURRENT live set.
+
+        Contract (TableRebalancer.java:122-134): a segment never has fewer
+        than min_available_replicas live copies during the move — new
+        replicas are added (server.add_segment) BEFORE old ones drop."""
+        meta = self.tables[table]
+        moved = added = dropped = 0
+        for seg_name in list(meta.ideal):
+            current = meta.ideal[seg_name]
+            live_now = {s for s in current if s in self.live}
+            desired = set(self._assign_for_rebalance(meta, seg_name))
+            if desired == current:
+                continue
+            segment = self._find_segment_object(table, seg_name, current | self.live)
+            if segment is None:
+                continue  # no live copy to replicate from
+            # add new replicas first (keeps availability)
+            for s in sorted(desired - current):
+                self.servers[s].add_segment(table, segment)
+                added += 1
+            survivors = {s for s in desired if s in self.live}
+            for s in sorted(current - desired):
+                if len(survivors) >= min_available_replicas and s in self.servers:
+                    self.servers[s].drop_segment(table, seg_name)
+                    dropped += 1
+                else:
+                    desired.add(s)  # keep the old copy: availability floor
+            meta.ideal[seg_name] = desired
+            moved += 1
+        return {"segmentsMoved": moved, "replicasAdded": added, "replicasDropped": dropped}
+
+    def _assign_for_rebalance(self, meta: TableMeta, seg_name: str) -> List[str]:
+        return self._assign(meta, seg_name)
+
+    def _find_segment_object(self, table: str, seg_name: str, candidates) -> Optional[ImmutableSegment]:
+        for s in candidates:
+            if s in self.live and s in self.servers:
+                seg = self.servers[s].get_segment(table, seg_name)
+                if seg is not None:
+                    return seg
+        return None
+
+    # -- periodic tasks ---------------------------------------------------
+    def run_retention(self, now_ms: Optional[int] = None) -> List[str]:
+        """RetentionManager: drop segments whose time range fell out of the
+        retention window."""
+        now_ms = now_ms or int(time.time() * 1000)
+        purged: List[str] = []
+        unit_ms = {"DAYS": 86_400_000, "HOURS": 3_600_000, "MINUTES": 60_000}
+        for table, meta in self.tables.items():
+            sc = meta.config.segments
+            if sc.retention_time_value is None:
+                continue
+            horizon = now_ms - sc.retention_time_value * unit_ms.get(sc.retention_time_unit, 86_400_000)
+            for seg_name in list(meta.ideal):
+                tr = meta.segment_meta.get(seg_name, {}).get("timeRange")
+                if tr is not None and tr[1] is not None and tr[1] < horizon:
+                    for s in meta.ideal.pop(seg_name):
+                        if s in self.servers:
+                            self.servers[s].drop_segment(table, seg_name)
+                    meta.segment_meta.pop(seg_name, None)
+                    purged.append(f"{table}/{seg_name}")
+        return purged
+
+    def status_report(self) -> Dict[str, Dict]:
+        """SegmentStatusChecker: per-table replica health."""
+        out: Dict[str, Dict] = {}
+        for table, meta in self.tables.items():
+            under = []
+            for seg, servers in meta.ideal.items():
+                live = sum(1 for s in servers if s in self.live)
+                if live < min(self.replication, len(servers)) or live == 0:
+                    under.append(seg)
+            out[table] = {
+                "segments": len(meta.ideal),
+                "underReplicated": under,
+                "liveServers": sorted(self.live),
+            }
+        return out

@@ -1,0 +1,63 @@
+"""Server instance: owns segments, executes per-segment query work.
+
+Reference parity: pinot-server ServerInstance (.../starter/ServerInstance.java
+:69-177) + HelixInstanceDataManager / BaseTableDataManager — the process that
+holds segment data and runs the single-stage executor over its local
+segments when the broker scatters a query.
+
+Re-design: segments stay the same ImmutableSegment objects (in one process
+the "download from deep store" step is a reference share / mmap re-open);
+execution reuses the SSE executor with its device pytree cache, so each
+logical server keeps its own HBM-resident working set.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from pinot_tpu.query import executor, reduce as reduce_mod
+from pinot_tpu.query.ir import QueryContext
+from pinot_tpu.query.result import ExecutionStats
+from pinot_tpu.segment.segment import ImmutableSegment
+
+
+class ServerInstance:
+    def __init__(self, name: str, device=None):
+        self.name = name
+        self.device = device
+        # table -> {segment name -> segment}
+        self.segments: Dict[str, Dict[str, ImmutableSegment]] = {}
+
+    # -- data manager ----------------------------------------------------
+    def add_segment(self, table: str, segment: ImmutableSegment) -> None:
+        self.segments.setdefault(table, {})[segment.name] = segment
+
+    def drop_segment(self, table: str, seg_name: str) -> None:
+        self.segments.get(table, {}).pop(seg_name, None)
+
+    def get_segment(self, table: str, seg_name: str) -> Optional[ImmutableSegment]:
+        return self.segments.get(table, {}).get(seg_name)
+
+    def segment_names(self, table: str) -> List[str]:
+        return list(self.segments.get(table, {}))
+
+    # -- query execution (InstanceRequestHandler analog) ------------------
+    def execute(self, ctx: QueryContext, seg_names: List[str]):
+        """Run one query over the named LOCAL segments; returns
+        (segment results, stats) — the DataTable the reference ships back."""
+        stats = ExecutionStats()
+        results = []
+        for name in seg_names:
+            seg = self.get_segment(ctx.table, name)
+            if seg is None:
+                raise KeyError(f"server {self.name} does not serve {ctx.table}/{name}")
+            stats.num_segments_queried += 1
+            stats.total_docs += seg.num_docs
+            if executor.prune_segment(ctx, seg):
+                stats.num_segments_pruned += 1
+                continue
+            res, seg_stats = executor.execute_segment(ctx, seg, device=self.device)
+            stats.num_segments_processed += 1
+            stats.num_docs_scanned += seg_stats.num_docs_scanned
+            stats.add_index_uses(seg_stats.filter_index_uses)
+            results.append(res)
+        return results, stats

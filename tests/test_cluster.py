@@ -1,0 +1,185 @@
+"""Cluster-layer tests: assignment, routing, failover, rebalance, retention,
+broker-side pruning — the contracts of PinotHelixResourceManager /
+TableRebalancer / BrokerRoutingManager, golden-checked against sqlite.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Coordinator, ServerInstance
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.config import SegmentsConfig, TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+from golden import assert_same_rows, sqlite_from_data
+
+
+def _schema():
+    return Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+
+
+def _config(**kw):
+    return TableConfig(name="t", segments=SegmentsConfig(time_column="ts"), **kw)
+
+
+def _data(n, seed, t0=1_700_000_000_000):
+    rng = np.random.default_rng(seed)
+    return {
+        "city": rng.choice(["sf", "nyc", "la"], n).astype(object),
+        "v": rng.integers(0, 100, n),
+        "ts": t0 + rng.integers(0, 86_400_000, n).astype(np.int64),
+    }
+
+
+def _cluster(n_servers=3, replication=2, **cfg_kw):
+    coord = Coordinator(replication=replication)
+    for i in range(n_servers):
+        coord.register_server(ServerInstance(f"server{i}"))
+    coord.add_table(_schema(), _config(**cfg_kw))
+    return coord
+
+
+QUERIES = [
+    "SELECT COUNT(*), SUM(v) FROM t",
+    "SELECT city, COUNT(*), SUM(v) FROM t GROUP BY city",
+    "SELECT COUNT(*) FROM t WHERE v > 50 AND city = 'sf'",
+]
+
+
+class TestAssignmentAndRouting:
+    def test_replicated_assignment(self):
+        coord = _cluster(n_servers=4, replication=2)
+        all_data = []
+        for i in range(6):
+            d = _data(500, seed=i)
+            all_data.append(d)
+            targets = coord.add_segment("t", build_segment(_schema(), d, f"seg{i}"))
+            assert len(targets) == 2  # replication 2 = one per replica group
+            groups = {coord.replica_group[s] for s in targets}
+            assert len(groups) == 2  # spread across groups
+        merged = {k: np.concatenate([d[k] for d in all_data]) for k in all_data[0]}
+        conn = sqlite_from_data("t", merged)
+        broker = Broker(coord)
+        for sql in QUERIES:
+            assert_same_rows(broker.query(sql).rows, conn.execute(sql).fetchall())
+
+    def test_kill_server_reroutes(self):
+        coord = _cluster(n_servers=4, replication=2)
+        all_data = []
+        for i in range(4):
+            d = _data(400, seed=10 + i)
+            all_data.append(d)
+            coord.add_segment("t", build_segment(_schema(), d, f"seg{i}"))
+        merged = {k: np.concatenate([d[k] for d in all_data]) for k in all_data[0]}
+        conn = sqlite_from_data("t", merged)
+        broker = Broker(coord)
+        before = broker.query(QUERIES[0]).rows
+        coord.mark_down("server0")  # replication 2 -> every segment still live
+        after = broker.query(QUERIES[0]).rows
+        assert_same_rows(before, conn.execute(QUERIES[0]).fetchall())
+        assert_same_rows(after, conn.execute(QUERIES[0]).fetchall())
+
+    def test_replica_group_selector(self):
+        coord = _cluster(n_servers=4, replication=2)
+        for i in range(4):
+            coord.add_segment("t", build_segment(_schema(), _data(300, seed=20 + i), f"seg{i}"))
+        broker = Broker(coord, selector="replicagroup")
+        res = broker.query("SELECT COUNT(*) FROM t")
+        assert res.rows[0][0] == 1200
+
+    def test_no_live_replica_raises(self):
+        coord = _cluster(n_servers=2, replication=1)
+        coord.add_segment("t", build_segment(_schema(), _data(100, seed=1), "seg0"))
+        for s in list(coord.live):
+            coord.mark_down(s)
+        broker = Broker(coord)
+        with pytest.raises(RuntimeError, match="no live replica"):
+            broker.query("SELECT COUNT(*) FROM t")
+
+
+class TestRebalance:
+    def test_rebalance_repairs_under_replication(self):
+        coord = _cluster(n_servers=3, replication=2)
+        for i in range(6):
+            coord.add_segment("t", build_segment(_schema(), _data(200, seed=30 + i), f"seg{i}"))
+        coord.mark_down("server1")
+        status = coord.status_report()["t"]
+        assert status["underReplicated"]  # some segments lost a replica
+        report = coord.rebalance("t")
+        assert report["replicasAdded"] > 0
+        # every segment now has >= 2 live replicas again (2 live servers)
+        view = coord.external_view("t")
+        assert all(len(srvs) >= 2 for srvs in view.values())
+        broker = Broker(coord)
+        assert broker.query("SELECT COUNT(*) FROM t").rows[0][0] == 1200
+
+    def test_rebalance_spreads_to_new_server(self):
+        coord = _cluster(n_servers=2, replication=1)
+        for i in range(8):
+            coord.add_segment("t", build_segment(_schema(), _data(100, seed=40 + i), f"seg{i}"))
+        s_new = ServerInstance("server_new")
+        coord.register_server(s_new)
+        coord.rebalance("t")
+        assert s_new.segment_names("t"), "new server received no segments"
+        broker = Broker(coord)
+        assert broker.query("SELECT COUNT(*) FROM t").rows[0][0] == 800
+
+
+class TestRetentionAndPruning:
+    def test_retention_purges_old_segments(self):
+        coord = _cluster(n_servers=2, replication=1)
+        cfg = coord.tables["t"].config
+        cfg.segments.retention_time_value = 7
+        cfg.segments.retention_time_unit = "DAYS"
+        now = 1_700_000_000_000 + 30 * 86_400_000
+        coord.add_segment("t", build_segment(_schema(), _data(100, seed=1, t0=now - 86_400_000), "fresh", table_config=cfg))
+        coord.add_segment("t", build_segment(_schema(), _data(100, seed=2, t0=now - 20 * 86_400_000), "stale", table_config=cfg))
+        purged = coord.run_retention(now_ms=now)
+        assert purged == ["t/stale"]
+        broker = Broker(coord)
+        assert broker.query("SELECT COUNT(*) FROM t").rows[0][0] == 100
+
+    def test_time_pruner(self):
+        coord = _cluster(n_servers=2, replication=1)
+        cfg = coord.tables["t"].config
+        t0 = 1_700_000_000_000
+        day = 86_400_000
+        for i in range(4):
+            coord.add_segment(
+                "t",
+                build_segment(_schema(), _data(100, seed=50 + i, t0=t0 + i * 10 * day), f"seg{i}", table_config=cfg),
+            )
+        broker = Broker(coord)
+        res = broker.query(f"SELECT COUNT(*) FROM t WHERE ts >= {t0 + 30 * day}")
+        # only seg3's window can overlap; 3 segments pruned broker-side
+        assert res.stats.num_segments_pruned >= 3
+        assert res.rows[0][0] == 100
+
+    def test_partition_pruner(self):
+        cfg = TableConfig(
+            name="t",
+            segments=SegmentsConfig(time_column="ts"),
+            partition_column="city",
+            num_partitions=3,
+        )
+        coord = Coordinator(replication=1)
+        for i in range(2):
+            coord.register_server(ServerInstance(f"server{i}"))
+        coord.add_table(_schema(), cfg)
+        # partition-pure segments: each holds a single city
+        counts = {}
+        for i, city in enumerate(["sf", "nyc", "la"]):
+            d = _data(200, seed=60 + i)
+            d["city"] = np.array([city] * 200, dtype=object)
+            counts[city] = 200
+            coord.add_segment("t", build_segment(_schema(), d, f"seg_{city}", table_config=cfg))
+        broker = Broker(coord)
+        res = broker.query("SELECT COUNT(*) FROM t WHERE city = 'nyc'")
+        assert res.rows[0][0] == 200
+        assert res.stats.num_segments_pruned >= 1  # non-nyc partitions pruned broker-side

@@ -80,6 +80,10 @@ def make_agg_inputs(agg_specs, aggs, agg_filter_fns, view, table_like, null_hand
             if ffn is not None:
                 ft, _ = ffn(cols, params)
                 mask = mask & ft
+            if getattr(fn, "mv_input", False):
+                raise NotImplementedError(
+                    "MV aggregations are not yet supported on the distributed stacked path"
+                )
             if spec.expr is None:
                 vals = mask
             elif fn.needs_codes:

@@ -304,6 +304,57 @@ class FirstWithTimeFunction(LastWithTimeFunction):
     pick_last = False
 
 
+# ---------------------------------------------------------------------------
+# Multi-value aggregations: COUNTMV/SUMMV/MINMV/MAXMV/AVGMV/DISTINCTCOUNTMV
+# ---------------------------------------------------------------------------
+class MVAggFunction(AggFunction):
+    """Wraps an SV aggregation to run over every ELEMENT of an MV column
+    (reference: SumMVAggregationFunction et al).  The planner hands the
+    padded [rows, max_len] value/code matrix with a combined row+length
+    mask; partials flatten and delegate — grouped keys broadcast across the
+    element axis, so one row's elements all land in its group."""
+
+    mv_input = True
+    field_kinds = None
+    vector_fields = True  # 2D inputs can't ride the sparse sort kernel
+
+    def __init__(self, base: AggFunction):
+        self.base = base
+        self.name = base.name + "mv"
+        self.fields = base.fields
+        self.needs_codes = base.needs_codes
+        self.needs_binding = base.needs_binding
+        self.pairwise_merge = base.pairwise_merge
+
+    def with_args(self, literal_args):
+        return MVAggFunction(self.base.with_args(literal_args))
+
+    def bind_column(self, info):
+        return MVAggFunction(self.base.bind_column(info))
+
+    def partial(self, values, mask):
+        return self.base.partial(values.reshape(-1), mask.reshape(-1))
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        import jax.numpy as jnp
+
+        n, m = mask.shape
+        k2 = jnp.broadcast_to(keys[:, None], (n, m)).reshape(-1)
+        return self.base.partial_grouped(values.reshape(-1), mask.reshape(-1), k2, num_groups)
+
+    def host_partial(self, p):
+        return self.base.host_partial(p)
+
+    def merge(self, a, b):
+        return self.base.merge(a, b)
+
+    def final(self, p):
+        return self.base.final(p)
+
+    def final_dtype(self):
+        return self.base.final_dtype()
+
+
 _EXTRA = (
     PercentileLogSketchFunction,
     DistinctCountThetaFunction,
@@ -313,6 +364,11 @@ _EXTRA = (
 )
 for _cls in _EXTRA:
     register(_cls())
+
+from pinot_tpu.query.functions import get_agg_function as _get  # noqa: E402
+
+for _base_name in ("count", "sum", "min", "max", "avg", "distinctcount"):
+    register(MVAggFunction(_get(_base_name)))
 
 # aliases matching the reference's surface
 from pinot_tpu.query.functions import _REGISTRY  # noqa: E402

@@ -263,6 +263,13 @@ class FilterCompiler:
         card = d.cardinality
         values = d.values
         pt = p.ptype
+        # Multi-value columns: predicates match a row when ANY element
+        # matches (the reference's per-value MV predicate semantics).  The
+        # padded code matrix evaluates elementwise, then any(axis=1); the
+        # padding code (== cardinality) must stay no-match, so code tables
+        # get an explicit False pad slot — including after NEQ/NOT_IN
+        # negation — and code ranges can never reach it (hi <= card).
+        is_mv = getattr(col, "is_multi_value", False)
 
         lo_code = hi_code = None
         table: Optional[np.ndarray] = None
@@ -303,11 +310,14 @@ class FilterCompiler:
         has_nulls = col.nulls is not None and self.null_handling
 
         # -- index-accelerated paths (no code scan) ----------------------
-        accel = self._try_index_paths(name, col, lo_code, hi_code, table, has_nulls)
-        if accel is not None:
-            return accel
+        if not is_mv:
+            accel = self._try_index_paths(name, col, lo_code, hi_code, table, has_nulls)
+            if accel is not None:
+                return accel
 
         if table is not None:
+            if is_mv:
+                table = np.append(table, False)  # padding code slot
             key = self._key("table")
             self.params[key] = table
             self.used_columns.add(name)
@@ -315,6 +325,8 @@ class FilterCompiler:
             def eval_table(cols, params, _key=key, _name=name, _has=has_nulls):
                 codes = cols[_name]["codes"].astype(jnp.int32)
                 t = params[_key][codes]
+                if t.ndim == 2:
+                    t = jnp.any(t, axis=1)
                 nulls = cols[_name].get("nulls") if _has else None
                 if nulls is not None:
                     t = t & ~nulls
@@ -331,6 +343,8 @@ class FilterCompiler:
         def eval_range(cols, params, _lo=lo_key, _hi=hi_key, _name=name, _has=has_nulls):
             codes = cols[_name]["codes"].astype(jnp.int32)
             t = (codes >= params[_lo]) & (codes < params[_hi])
+            if t.ndim == 2:
+                t = jnp.any(t, axis=1)
             nulls = cols[_name].get("nulls") if _has else None
             if nulls is not None:
                 t = t & ~nulls

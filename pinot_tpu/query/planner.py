@@ -331,6 +331,10 @@ def _group_dim(expr: Expr, segment: ImmutableSegment, null_handling: bool) -> Gr
 
     if expr.is_column:
         c = segment.column(expr.op)
+        if getattr(c, "is_multi_value", False):
+            raise NotImplementedError(
+                f"GROUP BY on multi-value column {c.name} (explode semantics) is not yet supported"
+            )
         null_code = -1
         if c.has_dictionary:
             if c.nulls is not None and null_handling:
@@ -417,6 +421,28 @@ def bind_aggs(agg_specs, segment, ctx: QueryContext):
             fn = fn.bind_column(column_binding(spec, segment, ctx))
         out.append(fn)
     return out
+
+
+def mv_agg_input(spec, fn, segment, cols, mask):
+    """(values, mask) for an MV aggregation: padded [rows, max_len] element
+    matrix + combined row-filter x length mask."""
+    if spec.expr is None or not spec.expr.is_column:
+        raise ValueError(f"{spec.function} requires a multi-value column argument")
+    c = segment.column(spec.expr.op)
+    if not getattr(c, "is_multi_value", False):
+        raise ValueError(f"{spec.function} requires a multi-value column; {spec.expr.op} is single-value")
+    entry = cols[spec.expr.op]
+    codes = entry["codes"].astype(jnp.int32)
+    pad = jnp.arange(codes.shape[1], dtype=jnp.int32)[None, :] < entry["lengths"][:, None].astype(jnp.int32)
+    m2 = mask[:, None] & pad
+    if fn.needs_codes:
+        return codes, m2
+    if fn.base.name == "count":
+        return m2, m2
+    if c.data_type.is_string_like:
+        raise ValueError(f"{spec.function} needs numeric elements; {spec.expr.op} is {c.data_type.value}")
+    vals = entry["dict"][jnp.minimum(codes, np.int32(c.dictionary.cardinality - 1))]
+    return vals, m2
 
 
 def agg_input_codes(spec, fn, segment, cols, mask, null_handling: bool):
@@ -730,6 +756,9 @@ def _build_plan(
             if ffn is not None:
                 ft, _ = ffn(cols, params)
                 mask = mask & ft
+            if getattr(fn, "mv_input", False):
+                out.append(mv_agg_input(spec, fn, segment, cols, mask))
+                continue
             if spec.expr is None:
                 vals = mask  # COUNT(*): values unused
             elif fn.needs_codes:

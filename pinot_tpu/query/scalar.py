@@ -381,6 +381,12 @@ def expr_int_range(expr, segment) -> Optional[Tuple[int, int]]:
         glo = int(f(jnp.asarray([lo], dtype=jnp.int64), *lits)[0])
         ghi = int(f(jnp.asarray([hi], dtype=jnp.int64), *lits)[0])
         return (min(glo, ghi), max(glo, ghi))
+    if op in ("arraylength", "cardinality") and len(expr.args) == 1 and expr.args[0].is_column:
+        c = segment.column(expr.args[0].op)
+        ml = getattr(c, "mv_lengths", None)
+        if ml is not None and len(ml):
+            return (0, int(ml.max()))
+        return None
     if op in ("plus", "add", "minus", "sub", "times", "mult") and len(expr.args) == 2:
         ra = expr_int_range(expr.args[0], segment)
         rb = expr_int_range(expr.args[1], segment)

@@ -109,6 +109,11 @@ def eval_expr(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
         if dt is None:
             raise ValueError(f"unsupported CAST target {target}")
         return astype(a, dt), na
+    if op in ("arraylength", "cardinality") and len(expr.args) == 1 and expr.args[0].is_column:
+        entry = cols[expr.args[0].op]
+        if "lengths" not in entry:
+            raise ValueError(f"{op} requires a multi-value column ({expr.args[0].op} is single-value)")
+        return entry["lengths"].astype(jnp.int32), None
     if op in ("least", "greatest") and expr.args:
         vals, nulls = zip(*(eval_expr(a, segment, cols) for a in expr.args))
         acc, nl = vals[0], nulls[0]
@@ -153,6 +158,11 @@ def eval_expr_host(expr: Expr, segment: ImmutableSegment, docids: np.ndarray) ->
         return segment.column(expr.op).decoded()[docids]
     if expr.kind is ExprKind.LITERAL:
         return np.full(len(docids), expr.value)
+    if expr.op in ("arraylength", "cardinality") and len(expr.args) == 1 and expr.args[0].is_column:
+        c = segment.column(expr.args[0].op)
+        if c.mv_lengths is None:
+            raise ValueError(f"{expr.op} requires a multi-value column")
+        return c.mv_lengths[docids].astype(np.int64)
     if scalar.is_dict_fn_expr(expr):
         col = next(a for a in expr.args if not a.is_literal).op
         c = segment.column(col)

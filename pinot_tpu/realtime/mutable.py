@@ -79,6 +79,10 @@ class MutableSegment:
         self._buffers: Dict[str, List[Any]] = {}
         self._null_counts: Dict[str, int] = {}
         for f in schema.fields:
+            if not f.single_value:
+                raise NotImplementedError(
+                    f"multi-value column {f.name} in a realtime (mutable) table is not yet supported"
+                )
             self._buffers[f.name] = []
             self._null_counts[f.name] = 0
             if f.data_type.is_string_like:

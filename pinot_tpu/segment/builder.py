@@ -111,9 +111,18 @@ def build_segment(
     num_docs = lengths[names[0]] if names else 0
 
     # Extract nulls + typed arrays first (record-transformer analog).
+    # Multi-value fields keep their raw list-of-lists shape here; they build
+    # through the dedicated MV path below (null -> empty array, like the
+    # reference's default MV null handling).
     arrays: Dict[str, np.ndarray] = {}
     nulls: Dict[str, Optional[np.ndarray]] = {}
     for f in schema.fields:
+        if not f.single_value:
+            arrays[f.name] = np.asarray(
+                [tuple(v) if v is not None else () for v in data[f.name]], dtype=object
+            )
+            nulls[f.name] = None
+            continue
         arrays[f.name], nulls[f.name] = _extract_nulls(f, data[f.name])
 
     # Sort by the configured sorted column (Pinot keeps segments sorted when
@@ -132,6 +141,9 @@ def build_segment(
     indexes: Dict[str, Dict[str, Any]] = {}
     for f in schema.fields:
         arr, nmask = arrays[f.name], nulls[f.name]
+        if not f.single_value:
+            columns[f.name] = _build_mv_column(f, arr, num_docs)
+            continue
         use_dict = _wants_dictionary(f, idx_cfg)
         if use_dict:
             dictionary, codes32 = Dictionary.build(f.data_type, arr)
@@ -196,6 +208,39 @@ def build_segment(
     if output_dir is not None:
         seg.save(output_dir)
     return seg
+
+
+def _build_mv_column(f, lists: np.ndarray, num_docs: int) -> ColumnData:
+    """Multi-value column: dictionary over the FLATTENED values + a padded
+    [num_docs, max_len] code matrix with per-row lengths.
+
+    Reference parity: FixedBitMVForwardIndexReader (pinot-segment-local/...
+    readers/forward/FixedBitMVForwardIndexReader.java) stores var-length
+    code runs; the TPU layout is fixed-width padded — a dense matrix the
+    kernels scan with a length mask (static shapes, no row offsets).
+    Padding cells hold code == cardinality (one past the dictionary), which
+    every predicate table/range treats as no-match."""
+    flat: list = []
+    lengths = np.empty(num_docs, dtype=np.int32)
+    for i, row in enumerate(lists):
+        lengths[i] = len(row)
+        flat.extend(row)
+    flat_arr = np.asarray(flat, dtype=object if f.data_type.is_string_like else f.data_type.np_dtype)
+    if flat_arr.dtype == object and not f.data_type.is_string_like:
+        flat_arr = flat_arr.astype(f.data_type.np_dtype)
+    dictionary, flat_codes = Dictionary.build(f.data_type, flat_arr)
+    card = dictionary.cardinality
+    max_len = max(1, int(lengths.max()) if num_docs else 1)
+    code_dt = min_code_dtype(card + 1)  # +1: the padding code
+    codes2d = np.full((num_docs, max_len), card, dtype=code_dt)
+    pos = 0
+    for i in range(num_docs):
+        ln = lengths[i]
+        codes2d[i, :ln] = flat_codes[pos : pos + ln]
+        pos += ln
+    stats = collect_stats(f.name, f.data_type, flat_arr, None, card, True)
+    stats.num_docs = num_docs  # rows, not elements
+    return ColumnData(f.name, f.data_type, dictionary, codes2d, None, None, stats, mv_lengths=lengths)
 
 
 def _wants_dictionary(f, idx_cfg: IndexingConfig) -> bool:

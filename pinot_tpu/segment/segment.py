@@ -36,21 +36,34 @@ class ColumnData:
     name: str
     data_type: DataType
     dictionary: Optional[Dictionary]  # None => raw storage
-    codes: Optional[np.ndarray]  # uint8/16/32[num_docs] when dict-encoded
+    codes: Optional[np.ndarray]  # uint8/16/32[num_docs] (SV) or [num_docs, max_len] (MV)
     values: Optional[np.ndarray]  # raw storage (numeric) when no dictionary
     nulls: Optional[np.ndarray]  # bool[num_docs] true=null, None if no nulls
     stats: ColumnStats
+    # multi-value columns: per-row element counts; codes beyond a row's
+    # length hold the padding code (== cardinality)
+    mv_lengths: Optional[np.ndarray] = None
 
     @property
     def has_dictionary(self) -> bool:
         return self.dictionary is not None
 
     @property
+    def is_multi_value(self) -> bool:
+        return self.mv_lengths is not None
+
+    @property
     def cardinality(self) -> int:
         return self.dictionary.cardinality if self.dictionary else self.stats.cardinality
 
     def decoded(self) -> np.ndarray:
-        """Materialize raw values host-side (tests/golden comparisons)."""
+        """Materialize raw values host-side (tests/golden comparisons).
+        MV columns decode to an object array of tuples."""
+        if self.mv_lengths is not None:
+            out = np.empty(len(self.mv_lengths), dtype=object)
+            for i, ln in enumerate(self.mv_lengths):
+                out[i] = tuple(self.dictionary.get_values(self.codes[i, :ln]))
+            return out
         if self.dictionary is not None:
             return self.dictionary.get_values(self.codes)
         return self.values
@@ -123,6 +136,8 @@ class ImmutableSegment:
                 entry["values"] = jax.device_put(np.asarray(c.values), device)
             if c.nulls is not None:
                 entry["nulls"] = jax.device_put(np.asarray(c.nulls), device)
+            if c.mv_lengths is not None:
+                entry["lengths"] = jax.device_put(np.asarray(c.mv_lengths), device)
             cache[cname] = entry
         return {cname: cache[cname] for cname in cols}
 
@@ -141,10 +156,13 @@ class ImmutableSegment:
                 regions.append((f"{c.name}.fwd", c.values))
             if c.nulls is not None:
                 regions.append((f"{c.name}.nulls", np.packbits(c.nulls)))
+            if c.mv_lengths is not None:
+                regions.append((f"{c.name}.mvlen", c.mv_lengths))
             col_meta.append(
                 {
                     "stats": c.stats.to_dict(),
                     "hasNulls": c.nulls is not None,
+                    "isMV": c.mv_lengths is not None,
                 }
             )
         for kind, by_col in self.indexes.items():
@@ -183,7 +201,8 @@ class ImmutableSegment:
             if stats.has_dictionary:
                 dictionary = Dictionary.from_regions(dt, regions, name)
                 codes = regions[f"{name}.fwd"]
-                columns[name] = ColumnData(name, dt, dictionary, codes, None, nulls, stats)
+                mv_lengths = regions[f"{name}.mvlen"] if cm.get("isMV") else None
+                columns[name] = ColumnData(name, dt, dictionary, codes, None, nulls, stats, mv_lengths=mv_lengths)
             else:
                 columns[name] = ColumnData(name, dt, None, None, regions[f"{name}.fwd"], nulls, stats)
         indexes: Dict[str, Dict[str, Any]] = {}

@@ -10,13 +10,19 @@ from typing import Any, Dict
 
 from pinot_tpu.indexes.bloom import BloomFilter
 from pinot_tpu.indexes.inverted import InvertedIndex, RangeEncodedIndex
+from pinot_tpu.indexes.jsonidx import JsonIndex
 from pinot_tpu.indexes.startree import StarTreeIndex
+from pinot_tpu.indexes.text import TextIndex
+from pinot_tpu.indexes.vector import VectorIndex
 
 _REGISTRY = {
     InvertedIndex.KIND: InvertedIndex,
     RangeEncodedIndex.KIND: RangeEncodedIndex,
     BloomFilter.KIND: BloomFilter,
     StarTreeIndex.KIND: StarTreeIndex,
+    JsonIndex.KIND: JsonIndex,
+    TextIndex.KIND: TextIndex,
+    VectorIndex.KIND: VectorIndex,
 }
 
 

@@ -1,0 +1,63 @@
+"""Vector index: HBM-resident normalized embedding matrix, brute-force top-k.
+
+Reference parity: Pinot's Lucene-HNSW vector index + VECTOR_SIMILARITY
+predicate (pinot-core/.../operator/filter/VectorSimilarityFilterOperator.java).
+
+Re-design (SURVEY.md §2.4: "vector ANN: TPU brute-force/IVF matmul scan is
+idiomatic"): no graph structure — the index IS a row-normalized [n, d]
+float32 matrix pinned in HBM.  VECTOR_SIMILARITY(col, q, k) becomes one
+matvec on the MXU + jax.lax.top_k, exact (recall 1.0, which HNSW cannot
+claim) and fast up to tens of millions of rows per chip.  Cosine similarity
+via pre-normalized rows; zero-length/padded rows get -inf score."""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+
+class VectorIndex:
+    KIND = "vector"
+
+    def __init__(self, matrix: np.ndarray, dim: int):
+        self.matrix = matrix  # [n, d] float32, rows L2-normalized (0 rows stay 0)
+        self.dim = dim
+
+    @staticmethod
+    def build(values: np.ndarray, lengths: np.ndarray) -> "VectorIndex":
+        """values: padded [n, max_len] float matrix; rows with length != the
+        modal dimension are zeroed (score -inf at query time)."""
+        m = np.asarray(values, dtype=np.float32)
+        dims = np.bincount(lengths[lengths > 0]) if len(lengths) else np.array([1])
+        dim = int(np.argmax(dims)) if dims.size else m.shape[1]
+        ok = lengths == dim
+        m = np.where(ok[:, None], m, 0.0)[:, :dim]
+        norms = np.linalg.norm(m, axis=1, keepdims=True)
+        norms[norms == 0] = 1.0
+        return VectorIndex((m / norms).astype(np.float32), dim)
+
+    def normalize_query(self, q) -> np.ndarray:
+        q = np.asarray(q, dtype=np.float32).reshape(-1)
+        if len(q) != self.dim:
+            raise ValueError(f"query vector dim {len(q)} != index dim {self.dim}")
+        n = np.linalg.norm(q)
+        return q / (n if n else 1.0)
+
+    # -- persistence -------------------------------------------------------
+    def to_regions(self, prefix: str):
+        return [(f"{prefix}.mat", self.matrix)]
+
+    def meta(self) -> Dict[str, Any]:
+        return {"kind": self.KIND, "dim": self.dim}
+
+    @staticmethod
+    def from_regions(meta: Dict[str, Any], regions, prefix: str) -> "VectorIndex":
+        return VectorIndex(np.asarray(regions[f"{prefix}.mat"]), meta["dim"])
+
+
+def parse_query_vector(raw) -> np.ndarray:
+    """VECTOR_SIMILARITY's query argument: a JSON-array string or sequence."""
+    if isinstance(raw, str):
+        return np.asarray(json.loads(raw), dtype=np.float32)
+    return np.asarray(raw, dtype=np.float32)

@@ -137,6 +137,13 @@ class FilterCompiler:
             return None
         return idx.get(kind, {}).get(name)
 
+    def _cache_index(self, kind: str, name: str, idx) -> None:
+        """Cache a lazily-built (text/json) index on the segment so repeated
+        queries pay the cardinality-sized build once."""
+        store = getattr(self.segment, "indexes", None)
+        if isinstance(store, dict):
+            store.setdefault(kind, {})[name] = idx
+
     # ------------------------------------------------------------------
     def compile(self, node: Optional[FilterNode]) -> Callable[[Dict, Dict], MaskPair]:
         if node is None:
@@ -215,16 +222,54 @@ class FilterCompiler:
 
             return eval_null
 
+        if p.ptype is PredicateType.VECTOR_SIMILARITY:
+            return self._compile_vector_predicate(p)
         if p.lhs.is_column and seg.column(p.lhs.op).has_dictionary:
             return self._compile_dict_predicate(p)
         from pinot_tpu.query import scalar
 
         if (
             scalar.is_dict_fn_expr(p.lhs)
-            and p.lhs.op in scalar.STRING_RESULT_DICT_FNS
+            and scalar.string_result(p.lhs)
         ):
             return self._compile_derived_string_predicate(p)
         return self._compile_value_predicate(p)
+
+    def _compile_vector_predicate(self, p: Predicate) -> Callable[[Dict, Dict], MaskPair]:
+        """VECTOR_SIMILARITY(col, queryVec, topK): one MXU matvec over the
+        HBM-resident embedding matrix + lax.top_k — exact cosine top-k (the
+        reference's HNSW is approximate; brute-force is the TPU-idiomatic
+        trade, indexes/vector.py).  Ties at the kth score may admit extras."""
+        import jax
+
+        from pinot_tpu.indexes.vector import parse_query_vector
+
+        if not p.lhs.is_column:
+            raise ValueError("VECTOR_SIMILARITY requires a bare vector column")
+        name = p.lhs.op
+        vidx = self._col_index("vector", name)
+        if vidx is None:
+            raise ValueError(
+                f"VECTOR_SIMILARITY requires a vector index on {name} (tableIndexConfig.vectorIndexColumns)"
+            )
+        q = vidx.normalize_query(parse_query_vector(p.values[0]))
+        k = int(p.values[1]) if len(p.values) > 1 else 10
+        key = self._key("qvec")
+        self.params[key] = q
+        self.used_columns.add(name)
+        self.index_uses.append((name, "vector"))
+        dim = vidx.dim
+
+        def eval_vec(cols, params, _key=key, _name=name, _k=k, _dim=dim):
+            m = cols[_name]["values"][:, :_dim].astype(jnp.float32)
+            norms = jnp.sqrt(jnp.sum(m * m, axis=1))
+            scores = (m @ params[_key]) / jnp.where(norms == 0, 1.0, norms)
+            scores = jnp.where(norms == 0, -jnp.inf, scores)
+            kk = min(_k, scores.shape[0])
+            thresh = jax.lax.top_k(scores, kk)[0][-1]
+            return scores >= thresh, None
+
+        return eval_vec
 
     def _compile_derived_string_predicate(self, p: Predicate) -> Callable[[Dict, Dict], MaskPair]:
         """Predicate over a string function of a dict column — e.g.
@@ -304,6 +349,26 @@ class FilterCompiler:
             rx = re.compile(pat if pt is PredicateType.REGEXP_LIKE else like_to_regex(pat))
             # regex over the dictionary, not the rows — card evaluations total.
             table = np.fromiter((rx.search(str(v)) is not None for v in values), dtype=bool, count=card)
+        elif pt is PredicateType.TEXT_MATCH:
+            from pinot_tpu.indexes.text import TextIndex
+
+            idx = self._col_index("text", name)
+            if idx is None:
+                idx = TextIndex.build(values)  # lazy: cardinality work, cached below
+                self._cache_index("text", name, idx)
+            else:
+                self.index_uses.append((name, "text"))
+            table = idx.match(str(p.values[0]))
+        elif pt is PredicateType.JSON_MATCH:
+            from pinot_tpu.indexes.jsonidx import JsonIndex
+
+            idx = self._col_index("json", name)
+            if idx is None:
+                idx = JsonIndex.build(values)
+                self._cache_index("json", name, idx)
+            else:
+                self.index_uses.append((name, "json"))
+            table = idx.match(str(p.values[0]))
         else:
             raise ValueError(f"predicate {pt} not supported on dictionary column {name}")
 

@@ -163,6 +163,12 @@ def _segment_signature(
     sig = [segment.num_docs, segment.valid_docs is not None]
     for name in sorted(needed):
         c = segment.column(name)
+        # MV columns: the padded width is a static kernel shape, and the
+        # vector predicate bakes the index dim — both join the key.
+        mv_width = None
+        if getattr(c, "mv_lengths", None) is not None:
+            arr = c.codes if c.codes is not None else c.values
+            mv_width = int(arr.shape[1]) if arr is not None and arr.ndim == 2 else None
         # Raw columns include min/max: the kernel bakes rawint group-dim
         # base/cardinality in statically, so they are part of the cache key.
         raw_range = None
@@ -191,6 +197,7 @@ def _segment_signature(
                 sketch_extra,
                 column_limb_sig(c),
                 c.stats.is_sorted,
+                mv_width,
                 tuple(
                     sorted(
                         k
@@ -349,7 +356,7 @@ def _group_dim(expr: Expr, segment: ImmutableSegment, null_handling: bool) -> Gr
         raise NotImplementedError(f"group-by on raw {c.data_type.value} column {c.name} is not groupable")
     # GROUP BY <expression> (ExpressionContext function analog):
     # string-valued dictionary function -> derived dictionary dimension
-    if scalar.is_dict_fn_expr(expr) and expr.op in scalar.STRING_RESULT_DICT_FNS:
+    if scalar.is_dict_fn_expr(expr) and scalar.string_result(expr):
         col = next(a for a in expr.args if not a.is_literal).op
         c = segment.column(col)
         if c.has_dictionary:

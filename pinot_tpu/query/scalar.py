@@ -298,9 +298,64 @@ DICT_FNS: Dict[str, Callable] = {
     "containsstr": _sv_num(lambda v, p: int(str(p) in v), np.uint8),
 }
 
+def _json_extract(values: np.ndarray, path, rtype, default=None) -> np.ndarray:
+    """JSON_EXTRACT_SCALAR(col, '$.path', 'type'[, default]) over dictionary
+    values (JsonExtractScalarTransformFunction analog, evaluated per
+    dictionary entry).  Path: $.a.b.c and [i] array access."""
+    import json as _json
+
+    rtype = str(rtype).upper()
+    steps = []
+    for part in str(path).lstrip("$").strip(".").split("."):
+        if not part:
+            continue
+        base, _, rest = part.partition("[")
+        if base:
+            steps.append(("key", base))
+        while rest:
+            idx, _, rest = rest.partition("]")
+            steps.append(("idx", int(idx)))
+            rest = rest.lstrip("[")
+    nulls = {"INT": -(2**31), "LONG": -(2**63), "FLOAT": float("-inf"), "DOUBLE": float("-inf"), "STRING": "null"}
+    missing = default if default is not None else nulls.get(rtype, "null")
+
+    def one(v):
+        try:
+            node = _json.loads(v)
+        except (TypeError, ValueError):
+            return missing
+        for kind, s in steps:
+            try:
+                node = node[s]
+            except (KeyError, IndexError, TypeError):
+                return missing
+        if isinstance(node, (dict, list)):
+            return _json.dumps(node) if rtype == "STRING" else missing
+        return node
+
+    out = [one(v) for v in values]
+    if rtype in ("INT", "LONG"):
+        return np.array([int(x) if not isinstance(x, str) else int(float(x)) for x in out], dtype=np.int64)
+    if rtype in ("FLOAT", "DOUBLE"):
+        return np.array([float(x) for x in out], dtype=np.float64)
+    return np.array([str(x) for x in out], dtype=object)
+
+
+DICT_FNS["json_extract_scalar"] = _json_extract
+
 STRING_RESULT_DICT_FNS = frozenset(
     {"upper", "lower", "trim", "ltrim", "rtrim", "reverse", "substr", "substring", "concat", "replace", "lpad", "rpad"}
 )
+
+
+def string_result(expr) -> bool:
+    """Does this dictionary-function expression produce STRING values?
+    (Routes between the derived-string host paths and numeric device
+    gathers; JSON_EXTRACT_SCALAR's result type is its literal argument.)"""
+    if expr.op == "json_extract_scalar":
+        lits = [a.value for a in expr.args if a.is_literal]
+        return len(lits) >= 2 and str(lits[1]).upper() == "STRING"
+    return expr.op in STRING_RESULT_DICT_FNS
 
 
 def is_dict_fn_expr(expr) -> bool:

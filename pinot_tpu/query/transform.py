@@ -137,7 +137,7 @@ def eval_expr(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
         c = segment.column(col)
         if not c.has_dictionary:
             raise ValueError(f"{op} requires a dictionary-encoded column ({col} is raw)")
-        if expr.op in scalar.STRING_RESULT_DICT_FNS:
+        if scalar.string_result(expr):
             raise ValueError(
                 f"string-valued {op}(...) never materializes on device; use it in "
                 "predicates, GROUP BY, or the select list (host paths)"

@@ -142,6 +142,11 @@ def build_segment(
     for f in schema.fields:
         arr, nmask = arrays[f.name], nulls[f.name]
         if not f.single_value:
+            if f.name in idx_cfg.vector_index_columns:
+                col, vidx = _build_vector_column(f, arr, num_docs)
+                columns[f.name] = col
+                indexes.setdefault("vector", {})[f.name] = vidx
+                continue
             columns[f.name] = _build_mv_column(f, arr, num_docs)
             continue
         use_dict = _wants_dictionary(f, idx_cfg)
@@ -155,6 +160,14 @@ def build_segment(
                 indexes.setdefault("inverted", {})[f.name] = InvertedIndex.build(codes32, card, num_docs)
             if f.name in idx_cfg.range_index_columns and card <= MAX_BITMAP_INDEX_CARDINALITY:
                 indexes.setdefault("range", {})[f.name] = RangeEncodedIndex.build(codes32, card, num_docs)
+            if f.name in idx_cfg.json_index_columns:
+                from pinot_tpu.indexes.jsonidx import JsonIndex
+
+                indexes.setdefault("json", {})[f.name] = JsonIndex.build(dictionary.values)
+            if f.name in idx_cfg.text_index_columns:
+                from pinot_tpu.indexes.text import TextIndex
+
+                indexes.setdefault("text", {})[f.name] = TextIndex.build(dictionary.values)
         else:
             if f.data_type.is_string_like:
                 raise ValueError(f"string column {f.name} requires a dictionary")
@@ -241,6 +254,23 @@ def _build_mv_column(f, lists: np.ndarray, num_docs: int) -> ColumnData:
     stats = collect_stats(f.name, f.data_type, flat_arr, None, card, True)
     stats.num_docs = num_docs  # rows, not elements
     return ColumnData(f.name, f.data_type, dictionary, codes2d, None, None, stats, mv_lengths=lengths)
+
+
+def _build_vector_column(f, lists: np.ndarray, num_docs: int):
+    """Embedding column: raw padded [n, dim] float32 matrix (no dictionary)
+    + a VectorIndex of the row-normalized matrix (indexes/vector.py)."""
+    from pinot_tpu.indexes.vector import VectorIndex
+
+    lengths = np.array([len(r) for r in lists], dtype=np.int32)
+    max_len = max(1, int(lengths.max()) if num_docs else 1)
+    mat = np.zeros((num_docs, max_len), dtype=np.float32)
+    for i, row in enumerate(lists):
+        mat[i, : len(row)] = np.asarray(row, dtype=np.float32)
+    flat = mat[np.arange(max_len)[None, :] < lengths[:, None]]
+    stats = collect_stats(f.name, f.data_type, flat.astype(np.float64), None, 0, False)
+    stats.num_docs = num_docs
+    col = ColumnData(f.name, f.data_type, None, None, mat, None, stats, mv_lengths=lengths)
+    return col, VectorIndex.build(mat, lengths)
 
 
 def _wants_dictionary(f, idx_cfg: IndexingConfig) -> bool:

@@ -62,7 +62,10 @@ class ColumnData:
         if self.mv_lengths is not None:
             out = np.empty(len(self.mv_lengths), dtype=object)
             for i, ln in enumerate(self.mv_lengths):
-                out[i] = tuple(self.dictionary.get_values(self.codes[i, :ln]))
+                if self.dictionary is not None:
+                    out[i] = tuple(self.dictionary.get_values(self.codes[i, :ln]))
+                else:
+                    out[i] = tuple(self.values[i, :ln].tolist())
             return out
         if self.dictionary is not None:
             return self.dictionary.get_values(self.codes)
@@ -204,12 +207,20 @@ class ImmutableSegment:
                 mv_lengths = regions[f"{name}.mvlen"] if cm.get("isMV") else None
                 columns[name] = ColumnData(name, dt, dictionary, codes, None, nulls, stats, mv_lengths=mv_lengths)
             else:
-                columns[name] = ColumnData(name, dt, None, None, regions[f"{name}.fwd"], nulls, stats)
+                mv_lengths = regions[f"{name}.mvlen"] if cm.get("isMV") else None
+                columns[name] = ColumnData(
+                    name, dt, None, None, regions[f"{name}.fwd"], nulls, stats, mv_lengths=mv_lengths
+                )
         indexes: Dict[str, Dict[str, Any]] = {}
         for kind, by_col in meta.get("indexes", {}).items():
             for cname, idx_meta in by_col.items():
                 idx = load_index(kind, idx_meta, regions, f"{cname}.{kind}")
                 indexes.setdefault(kind, {})[cname] = idx
+        # text indexes evaluate phrase queries over the ORIGINAL values —
+        # rehydrate them from the column dictionary (not persisted twice)
+        for cname, idx in indexes.get("text", {}).items():
+            if cname in columns and columns[cname].dictionary is not None:
+                idx.values = columns[cname].dictionary.values
         return ImmutableSegment(
             name=meta["segmentName"],
             table_name=meta["tableName"],

@@ -1,0 +1,182 @@
+"""JSON, text, and vector index tests.
+
+Reference model: JsonMatchFilterOperator + JSON index flattening,
+Lucene TEXT_MATCH, VectorSimilarityFilterOperator (HNSW -> exact brute-force
+matmul here).
+"""
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.indexes.jsonidx import JsonIndex, flatten_json
+from pinot_tpu.indexes.text import TextIndex
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.segment.segment import ImmutableSegment
+from pinot_tpu.spi.config import IndexingConfig, TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+N = 3000
+
+
+def _schema():
+    return Schema(
+        "docs",
+        [
+            FieldSpec("meta", DataType.JSON),
+            FieldSpec("body", DataType.STRING),
+            FieldSpec("embedding", DataType.FLOAT, single_value=False),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+
+
+def _config():
+    return TableConfig(
+        name="docs",
+        indexing=IndexingConfig(
+            json_index_columns=["meta"],
+            text_index_columns=["body"],
+            vector_index_columns=["embedding"],
+        ),
+    )
+
+
+WORDS = ["quick", "brown", "fox", "lazy", "dog", "jumps", "search", "engine", "analytics"]
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(47)
+    metas, bodies, embs = [], [], []
+    for i in range(N):
+        metas.append(
+            json.dumps(
+                {
+                    "user": {"id": int(rng.integers(0, 50)), "tier": ["free", "pro", "ent"][int(rng.integers(0, 3))]},
+                    "events": [{"kind": "click"}] * int(rng.integers(0, 3)),
+                    "score": float(np.round(rng.random() * 10, 2)),
+                }
+            )
+        )
+        bodies.append(" ".join(rng.choice(WORDS, size=6)))
+        embs.append(list(rng.normal(size=8).astype(float)))
+    return {
+        "meta": metas,
+        "body": bodies,
+        "embedding": embs,
+        "v": rng.integers(0, 100, N),
+    }
+
+
+@pytest.fixture(scope="module")
+def eng(data, tmp_path_factory):
+    e = QueryEngine()
+    e.register_table(_schema(), _config())
+    seg = build_segment(_schema(), data, "s0", table_config=_config())
+    path = str(tmp_path_factory.mktemp("jtv") / "s0")
+    seg.save(path)  # indexes must survive persistence
+    e.add_segment("docs", ImmutableSegment.load(path))
+    return e
+
+
+def _metas(data):
+    return [json.loads(m) for m in data["meta"]]
+
+
+class TestJsonIndex:
+    def test_flatten(self):
+        f = flatten_json({"a": {"b": 1}, "c": [{"d": "x"}, {"d": "y"}], "e": 2.5})
+        assert f["$.a.b"] == [1]
+        assert f["$.c[*].d"] == ["x", "y"]
+        assert f["$.e"] == [2.5]
+
+    def test_json_match_eq(self, eng, data):
+        res = eng.query("SELECT COUNT(*) FROM docs WHERE JSON_MATCH(meta, '\"$.user.tier\" = ''pro''')")
+        expected = sum(1 for m in _metas(data) if m["user"]["tier"] == "pro")
+        assert res.rows[0][0] == expected
+        assert ("meta", "json") in res.stats.filter_index_uses
+
+    def test_json_match_numeric_range_and_and(self, eng, data):
+        res = eng.query(
+            "SELECT COUNT(*) FROM docs WHERE JSON_MATCH(meta, '\"$.score\" > 5 AND \"$.user.tier\" != ''free''')"
+        )
+        expected = sum(1 for m in _metas(data) if m["score"] > 5 and m["user"]["tier"] != "free")
+        assert res.rows[0][0] == expected
+
+    def test_json_match_exists(self, eng, data):
+        res = eng.query("SELECT COUNT(*) FROM docs WHERE JSON_MATCH(meta, '\"$.events[*].kind\" IS NOT NULL')")
+        expected = sum(1 for m in _metas(data) if m["events"])
+        assert res.rows[0][0] == expected
+
+    def test_json_extract_scalar_filter_and_groupby(self, eng, data):
+        res = eng.query("SELECT COUNT(*) FROM docs WHERE JSON_EXTRACT_SCALAR(meta, '$.user.id', 'LONG') < 10")
+        expected = sum(1 for m in _metas(data) if m["user"]["id"] < 10)
+        assert res.rows[0][0] == expected
+        res2 = eng.query(
+            "SELECT JSON_EXTRACT_SCALAR(meta, '$.user.tier', 'STRING'), COUNT(*) FROM docs "
+            "GROUP BY JSON_EXTRACT_SCALAR(meta, '$.user.tier', 'STRING') ORDER BY JSON_EXTRACT_SCALAR(meta, '$.user.tier', 'STRING')"
+        )
+        from collections import Counter
+
+        expected2 = Counter(m["user"]["tier"] for m in _metas(data))
+        assert {r[0]: r[1] for r in res2.rows} == dict(expected2)
+
+
+class TestTextIndex:
+    def test_term_and(self, eng, data):
+        res = eng.query("SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, 'quick fox')")
+        expected = sum(1 for b in data["body"] if "quick" in b.split() and "fox" in b.split())
+        assert res.rows[0][0] == expected
+        assert ("body", "text") in res.stats.filter_index_uses
+
+    def test_or_and_not(self, eng, data):
+        res = eng.query("SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, 'search engine OR analytics NOT lazy')")
+        def match(b):
+            toks = set(b.split())
+            return ("search" in toks and "engine" in toks) or ("analytics" in toks and "lazy" not in toks)
+
+        assert res.rows[0][0] == sum(1 for b in data["body"] if match(b))
+
+    def test_phrase(self, eng, data):
+        res = eng.query('SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, \'"quick brown"\')')
+        expected = sum(1 for b in data["body"] if "quick brown" in b)
+        assert res.rows[0][0] == expected
+
+    def test_prefix_wildcard(self, eng, data):
+        res = eng.query("SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, 'jump*')")
+        expected = sum(1 for b in data["body"] if any(t.startswith("jump") for t in b.split()))
+        assert res.rows[0][0] == expected
+
+    def test_lazy_text_index_without_config(self, data):
+        """TEXT_MATCH works without a configured index (lazy dictionary
+        tokenization), it just isn't counted as an index use."""
+        e = QueryEngine()
+        e.register_table(_schema())
+        cfg = TableConfig(name="docs", indexing=IndexingConfig(vector_index_columns=["embedding"]))
+        e.add_segment("docs", build_segment(_schema(), data, "s0", table_config=cfg))
+        res = e.query("SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, 'dog')")
+        expected = sum(1 for b in data["body"] if "dog" in b.split())
+        assert res.rows[0][0] == expected
+
+
+class TestVectorIndex:
+    def test_top_k_exact(self, eng, data):
+        q = np.asarray(data["embedding"][17], dtype=np.float32)
+        qs = json.dumps([float(x) for x in q])
+        res = eng.query(f"SELECT v FROM docs WHERE VECTOR_SIMILARITY(embedding, '{qs}', 5) LIMIT 100")
+        # golden: exact cosine top-5
+        m = np.asarray(data["embedding"], dtype=np.float32)
+        mn = m / np.linalg.norm(m, axis=1, keepdims=True)
+        scores = mn @ (q / np.linalg.norm(q))
+        top5 = set(np.argsort(-scores)[:5].tolist())
+        got_vs = sorted(r[0] for r in res.rows)
+        expected_vs = sorted(int(data["v"][i]) for i in top5)
+        assert got_vs == expected_vs
+        assert ("embedding", "vector") in res.stats.filter_index_uses
+
+    def test_vector_with_metadata_filter(self, eng, data):
+        q = json.dumps([1.0] * 8)
+        res = eng.query(f"SELECT COUNT(*) FROM docs WHERE VECTOR_SIMILARITY(embedding, '{q}', 50) AND v > 50")
+        assert 0 < res.rows[0][0] <= 50

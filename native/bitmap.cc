@@ -1,0 +1,160 @@
+// Roaring-style compressed bitmap codec.
+//
+// Reference parity: the RoaringBitmap dependency Pinot uses for inverted /
+// range / json indexes and validDocIds (SURVEY.md 2.4) — the one place the
+// reference's "native" capability is a library, re-implemented here as the
+// framework's own C++ runtime component.
+//
+// Format (little-endian):
+//   u32 n_containers
+//   per container:
+//     u32 key        (chunk index: docId >> 16)
+//     u8  type       (0 = sorted u16 array, 1 = 8KiB bitmap)
+//     u32 count      (cardinality within the container)
+//     payload        (array: count * u16; bitmap: 8192 bytes)
+//
+// Containers switch to bitmaps above ARRAY_MAX entries — the classic
+// Roaring threshold where 2-byte entries stop beating the fixed 8KiB.
+
+#include <cstdint>
+#include <cstring>
+
+static const int64_t CHUNK = 65536;
+static const int64_t ARRAY_MAX = 4096;
+static const int64_t BITMAP_BYTES = 8192;
+
+struct Writer {
+  uint8_t* out;
+  int64_t cap;
+  int64_t pos;
+  bool ok;
+  void put(const void* src, int64_t n) {
+    if (!ok || pos + n > cap) { ok = false; return; }
+    memcpy(out + pos, src, n);
+    pos += n;
+  }
+  template <typename T> void put1(T v) { put(&v, sizeof(T)); }
+};
+
+extern "C" {
+
+// Upper bound for allocating the output buffer.
+int64_t rb_max_compressed_size(int64_t n_docs) {
+  int64_t containers = n_docs / ARRAY_MAX + 2;
+  return 4 + containers * (9 + BITMAP_BYTES);
+}
+
+// docs: sorted ascending, distinct. Returns bytes written, or -1 on overflow.
+int64_t rb_compress(const uint32_t* docs, int64_t n, uint8_t* out, int64_t cap) {
+  Writer w{out, cap, 0, true};
+  w.put1<uint32_t>(0);  // container count backpatched below
+  uint32_t n_containers = 0;
+  int64_t i = 0;
+  while (i < n && w.ok) {
+    uint32_t key = docs[i] >> 16;
+    int64_t j = i;
+    while (j < n && (docs[j] >> 16) == key) j++;
+    int64_t count = j - i;
+    w.put1<uint32_t>(key);
+    if (count <= ARRAY_MAX) {
+      w.put1<uint8_t>(0);
+      w.put1<uint32_t>((uint32_t)count);
+      for (int64_t k = i; k < j; k++) w.put1<uint16_t>((uint16_t)(docs[k] & 0xFFFF));
+    } else {
+      w.put1<uint8_t>(1);
+      w.put1<uint32_t>((uint32_t)count);
+      if (w.ok && w.pos + BITMAP_BYTES <= cap) {
+        uint8_t* bm = out + w.pos;
+        memset(bm, 0, BITMAP_BYTES);
+        for (int64_t k = i; k < j; k++) {
+          uint32_t low = docs[k] & 0xFFFF;
+          bm[low >> 3] |= (uint8_t)(1u << (low & 7));
+        }
+        w.pos += BITMAP_BYTES;
+      } else {
+        w.ok = false;
+      }
+    }
+    n_containers++;
+    i = j;
+  }
+  if (!w.ok) return -1;
+  memcpy(out, &n_containers, 4);
+  return w.pos;
+}
+
+}  // extern "C"
+
+struct Reader {
+  const uint8_t* buf;
+  int64_t len;
+  int64_t pos;
+  bool ok;
+  void get(void* dst, int64_t n) {
+    if (!ok || pos + n > len) { ok = false; return; }
+    memcpy(dst, buf + pos, n);
+    pos += n;
+  }
+  template <typename T> T get1() { T v{}; get(&v, sizeof(T)); return v; }
+  const uint8_t* skip(int64_t n) {
+    if (!ok || pos + n > len) { ok = false; return nullptr; }
+    const uint8_t* p = buf + pos;
+    pos += n;
+    return p;
+  }
+};
+
+extern "C" {
+
+int64_t rb_cardinality(const uint8_t* buf, int64_t len) {
+  Reader r{buf, len, 0, true};
+  uint32_t nc = r.get1<uint32_t>();
+  int64_t total = 0;
+  for (uint32_t c = 0; c < nc && r.ok; c++) {
+    r.get1<uint32_t>();  // key
+    uint8_t type = r.get1<uint8_t>();
+    uint32_t count = r.get1<uint32_t>();
+    total += count;
+    r.skip(type == 0 ? (int64_t)count * 2 : BITMAP_BYTES);
+  }
+  return r.ok ? total : -1;
+}
+
+// OR the compressed bitmap into dense u32 words (bit d of word d>>5).
+// Returns the bitmap's cardinality, or -1 on corruption/overflow.
+int64_t rb_decompress(const uint8_t* buf, int64_t len, uint32_t* words, int64_t n_words) {
+  Reader r{buf, len, 0, true};
+  uint32_t nc = r.get1<uint32_t>();
+  int64_t total = 0;
+  for (uint32_t c = 0; c < nc && r.ok; c++) {
+    uint32_t key = r.get1<uint32_t>();
+    uint8_t type = r.get1<uint8_t>();
+    uint32_t count = r.get1<uint32_t>();
+    int64_t base = (int64_t)key * CHUNK;
+    total += count;
+    if (type == 0) {
+      for (uint32_t k = 0; k < count && r.ok; k++) {
+        uint16_t low = r.get1<uint16_t>();
+        int64_t doc = base + low;
+        if ((doc >> 5) >= n_words) return -1;
+        words[doc >> 5] |= 1u << (doc & 31);
+      }
+    } else {
+      const uint8_t* bm = r.skip(BITMAP_BYTES);
+      if (!r.ok) return -1;
+      int64_t w0 = base >> 5;
+      const uint32_t* src = (const uint32_t*)bm;
+      // the words buffer may end mid-chunk (n_docs not a chunk multiple);
+      // bits past it must be absent or the data claims impossible docs
+      int64_t avail = n_words - w0;
+      if (avail < 0) avail = 0;
+      int64_t copy = avail < CHUNK / 32 ? avail : CHUNK / 32;
+      for (int64_t k = 0; k < copy; k++) words[w0 + k] |= src[k];
+      for (int64_t k = copy; k < CHUNK / 32; k++)
+        if (src[k]) return -1;
+    }
+  }
+  return r.ok ? total : -1;
+}
+
+}  // extern "C"

@@ -9,7 +9,7 @@ from __future__ import annotations
 from typing import Any, Dict
 
 from pinot_tpu.indexes.bloom import BloomFilter
-from pinot_tpu.indexes.inverted import InvertedIndex, RangeEncodedIndex
+from pinot_tpu.indexes.inverted import CompressedInvertedIndex, InvertedIndex, RangeEncodedIndex
 from pinot_tpu.indexes.jsonidx import JsonIndex
 from pinot_tpu.indexes.startree import StarTreeIndex
 from pinot_tpu.indexes.text import TextIndex
@@ -17,6 +17,7 @@ from pinot_tpu.indexes.vector import VectorIndex
 
 _REGISTRY = {
     InvertedIndex.KIND: InvertedIndex,
+    CompressedInvertedIndex.KIND: CompressedInvertedIndex,
     RangeEncodedIndex.KIND: RangeEncodedIndex,
     BloomFilter.KIND: BloomFilter,
     StarTreeIndex.KIND: StarTreeIndex,
@@ -31,7 +32,9 @@ def register_index(kind: str, cls) -> None:
 
 
 def load_index(kind: str, meta: Dict[str, Any], regions, prefix: str):
-    cls = _REGISTRY.get(kind)
+    # an index's meta may name a more specific implementation than its slot
+    # (e.g. "cinverted" stored under the "inverted" slot)
+    cls = _REGISTRY.get(meta.get("kind", kind)) or _REGISTRY.get(kind)
     if cls is None:
         raise ValueError(f"unknown index kind {kind!r} (have {list(_REGISTRY)})")
     return cls.from_regions(meta, regions, prefix)

@@ -1,0 +1,4 @@
+"""Ingestion input formats (pinot-plugins/pinot-input-format analog)."""
+from pinot_tpu.ingest.readers import CsvRecordReader, JsonRecordReader, read_csv_columns
+
+__all__ = ["CsvRecordReader", "JsonRecordReader", "read_csv_columns"]

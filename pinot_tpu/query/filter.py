@@ -504,10 +504,10 @@ class FilterCompiler:
         pos = np.nonzero(table)[0]
         neg_ids = np.nonzero(~table)[0]
         if len(pos) <= _INV_MAX_ROWS:
-            words = inv.doc_bitmap(pos) if len(pos) else np.zeros(inv.bitmaps.shape[1], np.uint32)
+            words = inv.doc_bitmap(pos) if len(pos) else np.zeros(inv.num_words, np.uint32)
             return self._emit_bitmap(name, words, "inverted", has_nulls, False)
         if len(neg_ids) <= _INV_MAX_ROWS:
-            words = inv.doc_bitmap(neg_ids) if len(neg_ids) else np.zeros(inv.bitmaps.shape[1], np.uint32)
+            words = inv.doc_bitmap(neg_ids) if len(neg_ids) else np.zeros(inv.num_words, np.uint32)
             return self._emit_bitmap(name, words, "inverted", has_nulls, True)
         return None
 
@@ -521,7 +521,7 @@ class FilterCompiler:
         inv = self._col_index("inverted", name)
         if inv is not None and (hi_code - lo_code) <= _INV_MAX_ROWS:
             ids = np.arange(lo_code, hi_code, dtype=np.int64)
-            words = inv.doc_bitmap(ids) if len(ids) else np.zeros(inv.bitmaps.shape[1], np.uint32)
+            words = inv.doc_bitmap(ids) if len(ids) else np.zeros(inv.num_words, np.uint32)
             return self._emit_bitmap(name, words, "inverted", has_nulls, False)
         return None
 

@@ -156,8 +156,17 @@ def build_segment(
             stats = collect_stats(f.name, f.data_type, arr, nmask, dictionary.cardinality, True)
             columns[f.name] = ColumnData(f.name, f.data_type, dictionary, codes, None, nmask, stats)
             card = dictionary.cardinality
-            if f.name in idx_cfg.inverted_index_columns and card <= MAX_BITMAP_INDEX_CARDINALITY:
-                indexes.setdefault("inverted", {})[f.name] = InvertedIndex.build(codes32, card, num_docs)
+            if f.name in idx_cfg.inverted_index_columns:
+                if card <= MAX_BITMAP_INDEX_CARDINALITY:
+                    indexes.setdefault("inverted", {})[f.name] = InvertedIndex.build(codes32, card, num_docs)
+                else:
+                    # high cardinality: sparse compressed postings, O(docs)
+                    # total storage (indexes/inverted.py CompressedInvertedIndex)
+                    from pinot_tpu.indexes.inverted import CompressedInvertedIndex
+
+                    indexes.setdefault("inverted", {})[f.name] = CompressedInvertedIndex.build(
+                        codes32, card, num_docs
+                    )
             if f.name in idx_cfg.range_index_columns and card <= MAX_BITMAP_INDEX_CARDINALITY:
                 indexes.setdefault("range", {})[f.name] = RangeEncodedIndex.build(codes32, card, num_docs)
             if f.name in idx_cfg.json_index_columns:

@@ -1,0 +1,132 @@
+"""Compressed doc-id bitmaps (RoaringBitmap analog) over the native codec.
+
+Used where dense [cardinality, words] bitmap tensors don't scale — the
+CompressedInvertedIndex posting lists (indexes/inverted.py) whose total
+storage is O(docs), not O(cardinality x docs).  The numpy fallback speaks
+the same byte format as native/bitmap.cc (round-trip tested), so segments
+compress/decompress identically with or without the toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import List
+
+import numpy as np
+
+from pinot_tpu.utils.native import get_lib
+
+_CHUNK = 65536
+_ARRAY_MAX = 4096
+_BITMAP_BYTES = 8192
+
+
+def compress(docs: np.ndarray) -> bytes:
+    """Sorted distinct doc ids -> compressed container bytes."""
+    docs = np.ascontiguousarray(docs, dtype=np.uint32)
+    lib = get_lib()
+    if lib is not None:
+        cap = int(lib.rb_max_compressed_size(len(docs)))
+        out = np.empty(cap, dtype=np.uint8)
+        n = lib.rb_compress(
+            docs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(docs),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            cap,
+        )
+        if n < 0:
+            raise RuntimeError("rb_compress overflow")
+        return bytes(out[:n])
+    return _compress_py(docs)
+
+
+def decompress_into_words(buf: bytes, words: np.ndarray) -> int:
+    """OR the compressed bitmap into dense u32 words; returns cardinality."""
+    lib = get_lib()
+    if lib is not None:
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        n = lib.rb_decompress(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            len(arr),
+            words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(words),
+        )
+        if n < 0:
+            raise ValueError("corrupt compressed bitmap")
+        return int(n)
+    return _decompress_py(buf, words)
+
+
+def cardinality(buf: bytes) -> int:
+    lib = get_lib()
+    if lib is not None:
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        return int(lib.rb_cardinality(arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(arr)))
+    return _cardinality_py(buf)
+
+
+# ---------------------------------------------------------------------------
+# numpy fallback, byte-compatible with native/bitmap.cc
+# ---------------------------------------------------------------------------
+def _compress_py(docs: np.ndarray) -> bytes:
+    parts: List[bytes] = []
+    keys = docs >> 16
+    n_containers = 0
+    i = 0
+    n = len(docs)
+    while i < n:
+        key = int(keys[i])
+        j = int(np.searchsorted(keys, key, side="right"))
+        lows = (docs[i:j] & 0xFFFF).astype(np.uint16)
+        count = j - i
+        head = np.uint32(key).tobytes() + bytes([0 if count <= _ARRAY_MAX else 1]) + np.uint32(count).tobytes()
+        if count <= _ARRAY_MAX:
+            parts.append(head + lows.tobytes())
+        else:
+            bits = np.zeros(_BITMAP_BYTES, dtype=np.uint8)
+            np.bitwise_or.at(bits, lows >> 3, (1 << (lows & 7)).astype(np.uint8))
+            parts.append(head + bits.tobytes())
+        n_containers += 1
+        i = j
+    return np.uint32(n_containers).tobytes() + b"".join(parts)
+
+
+def _decompress_py(buf: bytes, words: np.ndarray) -> int:
+    mv = memoryview(buf)
+    nc = int(np.frombuffer(mv[:4], dtype=np.uint32)[0])
+    pos = 4
+    total = 0
+    for _ in range(nc):
+        key = int(np.frombuffer(mv[pos : pos + 4], dtype=np.uint32)[0])
+        ctype = mv[pos + 4]
+        count = int(np.frombuffer(mv[pos + 5 : pos + 9], dtype=np.uint32)[0])
+        pos += 9
+        base = key * _CHUNK
+        total += count
+        if ctype == 0:
+            lows = np.frombuffer(mv[pos : pos + count * 2], dtype=np.uint16)
+            pos += count * 2
+            d = base + lows.astype(np.int64)
+            np.bitwise_or.at(words, d >> 5, (np.uint32(1) << (d & 31).astype(np.uint32)))
+        else:
+            bits = np.frombuffer(mv[pos : pos + _BITMAP_BYTES], dtype=np.uint8)
+            pos += _BITMAP_BYTES
+            w0 = base >> 5
+            src = bits.view(np.uint32)
+            copy = max(0, min(_CHUNK // 32, len(words) - w0))
+            words[w0 : w0 + copy] |= src[:copy]
+            if src[copy:].any():
+                raise ValueError("corrupt compressed bitmap: docs past buffer")
+    return total
+
+
+def _cardinality_py(buf: bytes) -> int:
+    mv = memoryview(buf)
+    nc = int(np.frombuffer(mv[:4], dtype=np.uint32)[0])
+    pos = 4
+    total = 0
+    for _ in range(nc):
+        ctype = mv[pos + 4]
+        count = int(np.frombuffer(mv[pos + 5 : pos + 9], dtype=np.uint32)[0])
+        pos += 9 + (count * 2 if ctype == 0 else _BITMAP_BYTES)
+        total += count
+    return total

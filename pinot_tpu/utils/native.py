@@ -1,0 +1,94 @@
+"""ctypes loader for the C++ runtime library (native/).
+
+The library builds on demand via the checked-in Makefile (g++, no external
+deps); every native entry point has a numpy fallback at its call site, so a
+missing toolchain degrades performance, never correctness.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_NATIVE_DIR = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", "..", "native"))
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libpinot_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        proc = subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        return proc.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64 = ctypes.c_int64
+    p8 = ctypes.POINTER(ctypes.c_uint8)
+    p32 = ctypes.POINTER(ctypes.c_uint32)
+    pc = ctypes.c_char_p
+    lib.rb_max_compressed_size.restype = i64
+    lib.rb_max_compressed_size.argtypes = [i64]
+    lib.rb_compress.restype = i64
+    lib.rb_compress.argtypes = [p32, i64, p8, i64]
+    lib.rb_cardinality.restype = i64
+    lib.rb_cardinality.argtypes = [p8, i64]
+    lib.rb_decompress.restype = i64
+    lib.rb_decompress.argtypes = [p8, i64, p32, i64]
+    lib.csv_count_rows.restype = i64
+    lib.csv_count_rows.argtypes = [pc, i64]
+    lib.csv_parse.restype = i64
+    lib.csv_parse.argtypes = [
+        pc,
+        i64,
+        ctypes.c_char,
+        i64,
+        ctypes.POINTER(i64),
+        ctypes.POINTER(i64),
+        p8,
+        i64,
+    ]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if the
+    toolchain is unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH):
+            src_newer = False
+        else:
+            lib_mtime = os.path.getmtime(_LIB_PATH)
+            src_newer = any(
+                os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > lib_mtime
+                for f in ("bitmap.cc", "csv.cc")
+                if os.path.exists(os.path.join(_NATIVE_DIR, f))
+            )
+        if (not os.path.exists(_LIB_PATH) or src_newer) and not _build():
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

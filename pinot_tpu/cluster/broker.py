@@ -104,6 +104,11 @@ class Broker:
         return self.execute(parse_query(sql))
 
     def execute(self, ctx: QueryContext) -> ResultTable:
+        from pinot_tpu.query.engine import apply_set_ops, resolve_subqueries
+
+        resolve_subqueries(ctx, self.execute)
+        if ctx.set_ops:
+            return apply_set_ops(ctx, self.execute)
         t0 = time.perf_counter()
         if ctx.joins:
             raise NotImplementedError("broker routes single-table queries; joins ride the MSE engine")

@@ -57,6 +57,9 @@ class QueryEngine:
 
     # -- execution -------------------------------------------------------
     def execute(self, ctx: QueryContext, device=None) -> ResultTable:
+        resolve_subqueries(ctx, lambda c: self.execute(c, device=device))
+        if ctx.set_ops:
+            return apply_set_ops(ctx, lambda c: self.execute(c, device=device))
         if ctx.joins:
             raise NotImplementedError(
                 "JOIN queries require the distributed engine "
@@ -132,3 +135,75 @@ class QueryEngine:
 
         ctx = parse_query(sql)
         return self.execute(ctx, device=device)
+
+
+# ---------------------------------------------------------------------------
+# Engine-agnostic rewrites (shared by QueryEngine / Broker / Distributed)
+# ---------------------------------------------------------------------------
+def resolve_subqueries(ctx: QueryContext, exec_fn) -> None:
+    """IN (SELECT ...) semi-joins: run the subquery, substitute its first
+    output column as the IN value set (the reference's IdSet/semi-join
+    rewrite in the Calcite planner).  An unspecified subquery LIMIT bumps to
+    the semi-join valve instead of Pinot's cosmetic default 10."""
+    from pinot_tpu.query.ir import FilterNode, FilterOp, Predicate, Subquery
+
+    def rewrite(node):
+        if node is None:
+            return None
+        if node.op is FilterOp.PRED:
+            p = node.predicate
+            if p is not None and p.values and isinstance(p.values[0], Subquery):
+                sub = p.values[0].ctx
+                if not sub.options.get("__hasExplicitLimit__", False):
+                    sub.limit = int(ctx.options.get("inSubqueryLimit", 1_000_000))
+                res = exec_fn(sub)
+                vals = tuple(sorted({r[0] for r in res.rows if r[0] is not None}))
+                return FilterNode.pred(
+                    Predicate(p.ptype, p.lhs, values=vals)
+                    if vals
+                    else Predicate(p.ptype, p.lhs, values=("\x00__nomatch__",))
+                )
+            return node
+        children = tuple(rewrite(c) for c in node.children)
+        return FilterNode(node.op, children=children, predicate=node.predicate)
+
+    ctx.filter = rewrite(ctx.filter)
+    if ctx.having is not None:
+        ctx.having = rewrite(ctx.having)
+
+
+def apply_set_ops(ctx: QueryContext, exec_fn) -> ResultTable:
+    """UNION [ALL] / INTERSECT / EXCEPT over component results (the MSE
+    SetOperator analog, executed at the broker-reduce level)."""
+    ops = ctx.set_ops
+    ctx.set_ops = []
+    try:
+        base = exec_fn(ctx)
+        rows = list(base.rows)
+        for op, all_flag, rhs_ctx in ops:
+            rhs = exec_fn(rhs_ctx)
+            if rhs.columns and base.columns and len(rhs.columns) != len(base.columns):
+                raise ValueError(
+                    f"set operation arity mismatch: {len(base.columns)} vs {len(rhs.columns)} columns"
+                )
+            if op == "union" and all_flag:
+                rows = rows + list(rhs.rows)
+            elif op == "union":
+                seen = set()
+                out = []
+                for r in rows + list(rhs.rows):
+                    if r not in seen:
+                        seen.add(r)
+                        out.append(r)
+                rows = out
+            elif op == "intersect":
+                rset = set(rhs.rows)
+                seen = set()
+                rows = [r for r in rows if r in rset and not (r in seen or seen.add(r))]
+            else:  # except
+                rset = set(rhs.rows)
+                seen = set()
+                rows = [r for r in rows if r not in rset and not (r in seen or seen.add(r))]
+        return ResultTable(columns=base.columns, rows=rows, stats=base.stats)
+    finally:
+        ctx.set_ops = ops

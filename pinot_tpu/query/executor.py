@@ -219,8 +219,18 @@ def sparse_tables_to_result(
 def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask: np.ndarray) -> SelectionSegmentResult:
     """Host-side row gather for selection queries, with per-segment trim
     (SelectionOnly / SelectionOrderBy operator analog)."""
+    from pinot_tpu.query.ir import WindowSpec
+
     docids = np.nonzero(tmask)[0]
-    want = ctx.offset + ctx.limit
+    # window functions rank/aggregate over ALL matched rows — per-segment
+    # trim would change results, so it is disabled (bounded by a valve)
+    if ctx.windows:
+        cap = int(ctx.options.get("maxWindowRows", 1_000_000))
+        if len(docids) > cap:
+            raise ValueError(f"window query matched {len(docids)} rows > maxWindowRows={cap}")
+        want = len(docids)
+    else:
+        want = ctx.offset + ctx.limit
     if ctx.order_by:
         for ob in ctx.order_by:
             if not ob.expr.is_column:
@@ -251,9 +261,23 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
             vals[c.nulls[docids]] = None
         return vals
 
+    def _value_array(e) -> np.ndarray:
+        return _decoded(e.op) if e.is_column else eval_expr_host(e, segment, docids)
+
     out_keys: List[str] = []
     items = plan.select_exprs or [planner.Expr.col(n) for n in plan.select_columns]
     for i, e in enumerate(items):
+        if isinstance(e, WindowSpec):
+            # placeholder output slot (reduce overwrites after the global
+            # merge) + the window's input arrays keyed by expr fingerprint
+            key = f"__win{i}"
+            out_keys.append(key)
+            arrays[key] = np.zeros(len(docids))
+            for ie in list(e.partition_by) + [o.expr for o in e.order_by] + ([e.expr] if e.expr else []):
+                wkey = f"__wx_{ie.fingerprint()}"
+                if wkey not in arrays:
+                    arrays[wkey] = _value_array(ie)
+            continue
         if e.is_column:
             out_keys.append(e.op)
             arrays[e.op] = _decoded(e.op)
@@ -279,6 +303,7 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
     for i, ob in enumerate(ctx.order_by):
         arrays[f"__ord{i}"] = _decoded(ob.expr.op)
     cols = out_keys + [f"__ord{i}" for i in range(len(ctx.order_by))]
+    cols += sorted(k for k in arrays if k.startswith("__wx_"))
     return SelectionSegmentResult(columns=cols, arrays=arrays)
 
 

@@ -246,6 +246,44 @@ class OrderByExpr:
 
 
 @dataclass(frozen=True)
+class WindowSpec:
+    """One window-function select item — fn(...) OVER (PARTITION BY ...
+    ORDER BY ...) (reference: WindowAggregateOperator,
+    pinot-query-runtime/.../runtime/operator/WindowAggregateOperator.java).
+
+    Frames are the whole partition (no ROWS BETWEEN) — ROW_NUMBER/RANK/
+    DENSE_RANK rank within the ordered partition; SUM/COUNT/AVG/MIN/MAX
+    aggregate the full partition.  Documented delta: running-frame variants
+    are unsupported."""
+
+    function: str  # row_number | rank | dense_rank | sum | count | avg | min | max
+    expr: Optional[Expr]
+    partition_by: Tuple[Expr, ...] = ()
+    order_by: Tuple[OrderByExpr, ...] = ()
+
+    def fingerprint(self) -> str:
+        e = self.expr.fingerprint() if self.expr else "*"
+        p = "|".join(x.fingerprint() for x in self.partition_by)
+        o = "|".join(f"{x.expr.fingerprint()}:{x.ascending}" for x in self.order_by)
+        return f"win:{self.function}({e})p[{p}]o[{o}]"
+
+    def __str__(self) -> str:
+        return f"{self.function}() OVER (...)"
+
+
+@dataclass(frozen=True)
+class Subquery:
+    """IN (SELECT ...) marker carried inside Predicate.values until the
+    engine resolves it (semi-join rewrite, reference: Calcite semi-join /
+    IN-subquery planning in QueryEnvironment)."""
+
+    ctx: "QueryContext"
+
+    def __repr__(self) -> str:
+        return f"Subquery({self.ctx.table})"
+
+
+@dataclass(frozen=True)
 class JoinClause:
     """One JOIN ... ON a = b clause (MSE JoinNode analog — the logical join
     of pinot-query-planner's LogicalJoin; only equi-joins, like the
@@ -291,12 +329,19 @@ class QueryContext:
     # allows `GROUP BY d ORDER BY SUM(v)` without selecting SUM(v); these are
     # computed alongside select aggregations but excluded from output rows.
     extra_aggregations: List[AggregationSpec] = dc_field(default_factory=list)
+    # set operations chained onto this query: (op, all_flag, rhs ctx) with
+    # op in {"union", "intersect", "except"} (MSE SetOperator analog)
+    set_ops: List[tuple] = dc_field(default_factory=list)
 
     @property
     def aggregations(self) -> List[AggregationSpec]:
         return [s for s in self.select_list if isinstance(s, AggregationSpec)] + list(
             self.extra_aggregations
         )
+
+    @property
+    def windows(self) -> List["WindowSpec"]:
+        return [s for s in self.select_list if isinstance(s, WindowSpec)]
 
     @property
     def is_aggregate(self) -> bool:
@@ -341,5 +386,6 @@ class QueryContext:
             str(self.limit),
             str(self.offset),
             str(sorted(self.options.items())),
+            "|".join(f"{op}:{al}:{c.fingerprint()}" for op, al, c in self.set_ops),
         ]
         return "\x1f".join(parts)

@@ -275,6 +275,8 @@ def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
         cols.extend(ctx.filter.columns())
     for g in ctx.group_by:
         cols.extend(g.columns())
+    from pinot_tpu.query.ir import WindowSpec
+
     for s in list(ctx.select_list) + list(ctx.extra_aggregations):
         if isinstance(s, AggregationSpec):
             if s.expr is not None:
@@ -283,6 +285,13 @@ def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
                 cols.extend(ex.columns())
             if s.filter:
                 cols.extend(s.filter.columns())
+        elif isinstance(s, WindowSpec):
+            if s.expr is not None:
+                cols.extend(s.expr.columns())
+            for p in s.partition_by:
+                cols.extend(p.columns())
+            for o in s.order_by:
+                cols.extend(o.expr.columns())
         else:
             cols.extend(s.columns())
     # ORDER BY/HAVING references to AGGREGATION aliases are resolved by
@@ -836,16 +845,23 @@ def _build_plan(
     fn = compiled_fn if compiled_fn is not None else jax.jit(kernel)
 
     select_columns = []
-    select_exprs: List[Expr] = []
+    select_exprs: List[Any] = []
     if kind == "selection":
+        from pinot_tpu.query.ir import WindowSpec
+
         for s in ctx.select_list:
+            if isinstance(s, WindowSpec):
+                select_exprs.append(s)  # computed at reduce over merged rows
+                continue
             if not isinstance(s, Expr):
                 raise NotImplementedError(f"unsupported selection item {s}")
             if s.is_column and s.op == "*":
                 select_exprs.extend(Expr.col(n) for n in segment.schema.column_names)
             else:
                 select_exprs.append(s)
-        select_columns = [e.op for e in select_exprs if e.is_column]
+        select_columns = [e.op for e in select_exprs if isinstance(e, Expr) and e.is_column]
+    elif ctx.windows:
+        raise NotImplementedError("window functions apply to selection queries only")
 
     return SegmentPlan(
         kind=kind,

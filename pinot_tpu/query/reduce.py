@@ -230,7 +230,15 @@ def _reduce_selection(ctx: QueryContext, results: List[SelectionSegmentResult], 
         for c in cols
     }
     n = len(next(iter(arrays.values()))) if arrays else 0
-    select_cols = [c for c in cols if not c.startswith("__ord")]
+    # window functions: computed HERE, over the globally merged row set
+    # (WindowAggregateOperator analog; whole-partition frames)
+    if ctx.windows:
+        from pinot_tpu.query.ir import WindowSpec
+
+        for i, s in enumerate(ctx.select_list):
+            if isinstance(s, WindowSpec):
+                arrays[f"__win{i}"] = _compute_window(s, arrays, n)
+    select_cols = [c for c in cols if not (c.startswith("__ord") or c.startswith("__wx_"))]
     rows = _rows_from_columns([arrays[c] for c in select_cols], n)
     if ctx.order_by:
         ord_vals = [arrays[f"__ord{i}"] for i in range(len(ctx.order_by))]
@@ -238,6 +246,79 @@ def _reduce_selection(ctx: QueryContext, results: List[SelectionSegmentResult], 
         rows = [rows[i] for i in order]
     rows = rows[ctx.offset: ctx.offset + ctx.limit]
     return ResultTable(columns=out_names, rows=rows, stats=stats)
+
+
+def _compute_window(spec, arrays: Dict[str, np.ndarray], n: int) -> np.ndarray:
+    """One window function over the merged result rows.
+
+    Partition ids by hashing the partition-key tuples; within each
+    partition, rows order by the OVER(ORDER BY ...) keys (stable).  Frames
+    are the whole partition (ir.WindowSpec contract)."""
+    pid = np.zeros(n, dtype=np.int64)
+    if spec.partition_by:
+        pkeys = [np.asarray(arrays[f"__wx_{p.fingerprint()}"]) for p in spec.partition_by]
+        seen: Dict[tuple, int] = {}
+        for i in range(n):
+            key = tuple(k[i] for k in pkeys)
+            pid[i] = seen.setdefault(key, len(seen))
+    okeys = [(np.asarray(arrays[f"__wx_{o.expr.fingerprint()}"]), o.ascending) for o in spec.order_by]
+    arg = np.asarray(arrays[f"__wx_{spec.expr.fingerprint()}"], dtype=np.float64) if spec.expr is not None else None
+
+    fn = spec.function
+    out = np.zeros(n, dtype=np.float64)
+    if fn in ("row_number", "rank", "dense_rank"):
+        # global stable sort by (pid, order keys) then rank within partition
+        lex: List[np.ndarray] = [pid]
+        for vals, asc in okeys:
+            # merged selection arrays are object-dtype; numeric values must
+            # rank numerically, genuine strings by sorted-unique codes
+            a = np.asarray(vals)
+            if a.dtype == object:
+                try:
+                    a = a.astype(np.float64)
+                except (ValueError, TypeError):
+                    pass
+            if np.issubdtype(a.dtype, np.number):
+                a = a.astype(np.float64)
+                lex.append(a if asc else -a)
+            else:
+                u, inv = np.unique(a.astype(str), return_inverse=True)
+                lex.append(inv if asc else -inv)
+        order = np.lexsort(tuple(reversed(lex)))
+        prev_pid = None
+        pos = rank = dense = 0
+        prev_key = None
+        for idx in order:
+            key = tuple(np.asarray(l)[idx] for l in lex[1:])
+            if pid[idx] != prev_pid:
+                prev_pid = pid[idx]
+                pos = rank = dense = 1
+                prev_key = key
+            else:
+                pos += 1
+                if key != prev_key:
+                    rank = pos
+                    dense += 1
+                    prev_key = key
+            out[idx] = pos if fn == "row_number" else (rank if fn == "rank" else dense)
+        return out.astype(np.int64)
+    # whole-partition aggregates
+    nparts = int(pid.max()) + 1 if n else 0
+    if fn == "count":
+        cnt = np.bincount(pid, minlength=nparts)
+        return cnt[pid].astype(np.int64)
+    if arg is None:
+        raise ValueError(f"window {fn} needs an argument")
+    if fn in ("sum", "avg"):
+        s = np.bincount(pid, weights=arg, minlength=nparts)
+        if fn == "sum":
+            return s[pid]
+        cnt = np.bincount(pid, minlength=nparts)
+        return (s / cnt)[pid]
+    ident = np.inf if fn == "min" else -np.inf
+    acc = np.full(nparts, ident)
+    (np.minimum if fn == "min" else np.maximum).at(acc, pid, arg)
+    return acc[pid]
 
 
 # ---------------------------------------------------------------------------

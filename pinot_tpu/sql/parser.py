@@ -30,6 +30,8 @@ from pinot_tpu.query.ir import (
     Predicate,
     PredicateType,
     QueryContext,
+    Subquery,
+    WindowSpec,
 )
 
 
@@ -90,6 +92,7 @@ KEYWORDS = {
     "as", "asc", "desc", "nulls", "first", "last", "set", "distinct",
     "true", "false", "filter", "option",
     "join", "on", "inner", "left", "right", "full", "cross", "outer",
+    "over", "partition", "union", "intersect", "except", "all",
 }
 
 
@@ -201,6 +204,14 @@ class _Parser:
             options[str(name)] = self.literal_value()
             self.expect_op(";")
         ctx = self.select_statement(options)
+        # set operations: SELECT ... UNION [ALL] SELECT ... (left-assoc)
+        while self.at_kw("union", "intersect", "except"):
+            op = self.advance().value
+            all_flag = self.accept_kw("all")
+            if all_flag and op != "union":
+                self.fail(f"{op.upper()} ALL is not supported")
+            rhs = self.select_statement(dict(options))
+            ctx.set_ops.append((op, all_flag, rhs))
         self.accept_op(";")
         if self.cur.kind != "eof":
             self.fail("unexpected trailing input")
@@ -261,6 +272,10 @@ class _Parser:
                     break
         limit = 10  # Pinot's default LIMIT 10
         offset = 0
+        if self.at_kw("limit"):
+            # semi-join subquery resolution distinguishes an explicit LIMIT
+            # from the cosmetic default (engine.resolve_subqueries)
+            options["__hasExplicitLimit__"] = True
         if self.accept_kw("limit"):
             limit = self.int_literal()
             if self.accept_op(","):
@@ -366,10 +381,22 @@ class _Parser:
                     filter=map_filter_columns(s.filter, strip_q),
                 )
 
-            select_list = [
-                strip_agg(s) if isinstance(s, AggregationSpec) else map_expr_columns(s, strip_q)
-                for s in select_list
-            ]
+            def strip_item(s):
+                if isinstance(s, AggregationSpec):
+                    return strip_agg(s)
+                if isinstance(s, WindowSpec):
+                    return WindowSpec(
+                        s.function,
+                        map_expr_columns(s.expr, strip_q) if s.expr is not None else None,
+                        tuple(map_expr_columns(p, strip_q) for p in s.partition_by),
+                        tuple(
+                            OrderByExpr(map_expr_columns(o.expr, strip_q), o.ascending, o.nulls_last)
+                            for o in s.order_by
+                        ),
+                    )
+                return map_expr_columns(s, strip_q)
+
+            select_list = [strip_item(s) for s in select_list]
             group_by = [map_expr_columns(g, strip_q) for g in group_by]
             where = map_filter_columns(where, strip_q)
             having = map_filter_columns(having, strip_q)
@@ -447,11 +474,44 @@ class _Parser:
     # a misleading selection-expression error.
     _KNOWN_UNIMPLEMENTED_AGGS = frozenset({"distinctcountrawhll", "distinctcountthetasketch"})
 
+    _WINDOW_FNS = frozenset({"row_number", "rank", "dense_rank", "sum", "count", "avg", "min", "max"})
+
     def expr_or_agg(self) -> Union[Expr, AggregationSpec]:
         """Expression that may be a top-level aggregation call."""
         e = self.expr()
         if isinstance(e, Expr) and e.kind.name == "CALL" and e.op in self._KNOWN_UNIMPLEMENTED_AGGS:
             self.fail(f"aggregation function {e.op!r} is not supported yet")
+        # window function: fn(...) OVER (PARTITION BY ... ORDER BY ...)
+        if isinstance(e, Expr) and e.kind.name == "CALL" and self.at_kw("over"):
+            if e.op not in self._WINDOW_FNS:
+                self.fail(f"{e.op!r} is not a supported window function")
+            self.advance()
+            self.expect_op("(")
+            partition: List[Expr] = []
+            worder: List[OrderByExpr] = []
+            if self.accept_kw("partition"):
+                self.expect_kw("by")
+                while True:
+                    partition.append(self.expr())
+                    if not self.accept_op(","):
+                        break
+            if self.accept_kw("order"):
+                self.expect_kw("by")
+                while True:
+                    oe = self.expr()
+                    asc = True
+                    if self.accept_kw("desc"):
+                        asc = False
+                    else:
+                        self.accept_kw("asc")
+                    worder.append(OrderByExpr(oe, ascending=asc))
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            arg = None
+            if e.args and not (e.args[0].is_column and e.args[0].op == "*"):
+                arg = e.args[0]
+            return WindowSpec(e.op, arg, tuple(partition), tuple(worder))
         if isinstance(e, Expr) and e.kind.name == "CALL" and is_agg_function(e.op):
             spec = self._call_to_agg(e)
             # FILTER (WHERE ...) clause — Pinot filtered aggregations
@@ -520,6 +580,12 @@ class _Parser:
         negate = self.accept_kw("not")
         if self.accept_kw("in"):
             self.expect_op("(")
+            if self.at_kw("select"):
+                # IN (SELECT ...) — semi-join marker resolved by the engine
+                sub = self.select_statement({})
+                self.expect_op(")")
+                pt = PredicateType.NOT_IN if negate else PredicateType.IN
+                return FilterNode.pred(Predicate(pt, lhs, values=(Subquery(sub),)))
             vals = [self.literal_value()]
             while self.accept_op(","):
                 vals.append(self.literal_value())

@@ -109,7 +109,10 @@ class Broker:
         resolve_subqueries(ctx, self.execute)
         if ctx.set_ops:
             return apply_set_ops(ctx, self.execute)
+        from pinot_tpu.query.safety import Deadline
+
         t0 = time.perf_counter()
+        deadline = Deadline.from_ctx(ctx)
         if ctx.joins:
             raise NotImplementedError("broker routes single-table queries; joins ride the MSE engine")
         table = ctx.table
@@ -123,6 +126,7 @@ class Broker:
             assign = self._route(table, seg_names)
             # scatter-gather (QueryRouter.submitQuery analog, in-process)
             for server_name, segs in assign.items():
+                deadline.check(f"query on {table}")
                 server = self.coordinator.servers[server_name]
                 res, sstats = server.execute(ctx, segs)
                 results.extend(res)

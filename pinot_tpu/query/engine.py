@@ -39,8 +39,11 @@ class TableState:
 
 
 class QueryEngine:
-    def __init__(self) -> None:
+    def __init__(self, memory_budget_bytes: int = 8 << 30) -> None:
+        from pinot_tpu.query.safety import MemoryAccountant
+
         self.tables: Dict[str, TableState] = {}
+        self.accountant = MemoryAccountant(memory_budget_bytes)
 
     # -- table registry (controller-lite) -------------------------------
     def register_table(self, schema: Schema, config: Optional[TableConfig] = None) -> None:
@@ -66,26 +69,90 @@ class QueryEngine:
                 "(parallel.DistributedEngine routes them to mse.MultiStageEngine); "
                 "the single-node QueryEngine serves single-table queries only"
             )
+        from pinot_tpu.query.safety import Deadline, estimate_segment_bytes
+        from pinot_tpu.utils.metrics import METRICS, Trace
+
         t0 = time.perf_counter()
+        deadline = Deadline.from_ctx(ctx)
+        trace = Trace(bool(ctx.options.get("trace", False)))
+        METRICS.counter("queries").inc()
         state = self.table(ctx.table)
         segments = state.query_segments()
+        if ctx.options.get("__explain__"):
+            return self._explain(ctx, segments)
         self._inject_global_ranges(ctx, state, segments)
+        # admission: charge the estimated device bytes up front (safety.py)
+        est = sum(estimate_segment_bytes(ctx, seg) for seg in segments)
+        qid = self.accountant.acquire(est)
         stats = ExecutionStats()
         results = []
-        for seg in segments:
-            stats.num_segments_queried += 1
-            stats.total_docs += seg.num_docs
-            if executor.prune_segment(ctx, seg):
-                stats.num_segments_pruned += 1
-                continue
-            res, seg_stats = executor.execute_segment(ctx, seg, device=device)
-            stats.num_segments_processed += 1
-            stats.num_docs_scanned += seg_stats.num_docs_scanned
-            stats.add_index_uses(seg_stats.filter_index_uses)
-            results.append(res)
-        out = reduce_mod.reduce_results(ctx, results, stats)
+        try:
+            for seg in segments:
+                deadline.check(f"query on {ctx.table}")
+                stats.num_segments_queried += 1
+                stats.total_docs += seg.num_docs
+                if executor.prune_segment(ctx, seg):
+                    stats.num_segments_pruned += 1
+                    continue
+                with trace.span(f"segment:{seg.name}"):
+                    res, seg_stats = executor.execute_segment(ctx, seg, device=device)
+                stats.num_segments_processed += 1
+                stats.num_docs_scanned += seg_stats.num_docs_scanned
+                stats.add_index_uses(seg_stats.filter_index_uses)
+                results.append(res)
+            deadline.check(f"query on {ctx.table}")
+            with trace.span("reduce"):
+                out = reduce_mod.reduce_results(ctx, results, stats)
+        except Exception:
+            METRICS.counter("queryExceptions").inc()
+            raise
+        finally:
+            self.accountant.release(qid)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
+        out.stats.trace = trace.finish()
+        METRICS.timer("queryLatency").update(out.stats.time_ms)
+        METRICS.counter("docsScanned").inc(stats.num_docs_scanned)
         return out
+
+    def _explain(self, ctx: QueryContext, segments) -> ResultTable:
+        """EXPLAIN PLAN FOR: per-shape operator tree rows (Pinot's explain
+        table: Operator / Operator_Id / Parent_Id)."""
+        from pinot_tpu.query import planner as planner_mod
+
+        rows = [("BROKER_REDUCE(" + ("sort/limit" if ctx.order_by else "limit") + ")", 1, 0)]
+        if not segments:
+            return ResultTable(columns=["Operator", "Operator_Id", "Parent_Id"], rows=rows, stats=ExecutionStats())
+        plan = planner_mod.plan_segment(ctx, segments[0])
+        oid = 2
+        rows.append((f"COMBINE_{plan.kind.upper()}", oid, 1))
+        parent = oid
+        oid += 1
+        if plan.kind == "aggregation":
+            rows.append((f"AGGREGATE({', '.join(str(a) for a in ctx.aggregations)})", oid, parent))
+        elif plan.kind.startswith("groupby"):
+            rows.append(
+                (
+                    f"GROUP_BY(keys: {', '.join(str(g) for g in ctx.group_by)}; "
+                    f"{'dense' if plan.kind == 'groupby_dense' else 'sparse'} table {plan.num_groups})",
+                    oid,
+                    parent,
+                )
+            )
+        else:
+            rows.append((f"SELECT(columns: {', '.join(plan.select_columns)})", oid, parent))
+        parent = oid
+        oid += 1
+        rows.append((f"PROJECT({', '.join(plan.needed_columns)})", oid, parent))
+        parent = oid
+        oid += 1
+        if plan.index_uses:
+            uses = ", ".join(f"{c}:{k}" for c, k in plan.index_uses)
+            rows.append((f"FILTER_INDEX({uses})", oid, parent))
+        elif ctx.filter is not None:
+            rows.append((f"FILTER_SCAN({ctx.filter.fingerprint()[:80]})", oid, parent))
+        else:
+            rows.append(("FILTER_MATCH_ALL", oid, parent))
+        return ResultTable(columns=["Operator", "Operator_Id", "Parent_Id"], rows=rows, stats=ExecutionStats())
 
     def attach_realtime(self, table: str, manager) -> None:
         """Bind a RealtimeTableDataManager to a registered table."""

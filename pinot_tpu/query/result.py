@@ -30,6 +30,8 @@ class ExecutionStats:
     # proof that the filter read bitmap/doc-range rows instead of scanning
     # codes (BitmapBasedFilterOperator analog; see query/filter.py)
     filter_index_uses: Tuple = ()
+    # span tree dict when the query ran with trace=true (utils/metrics.Trace)
+    trace: Optional[dict] = None
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_segments_queried += other.num_segments_queried

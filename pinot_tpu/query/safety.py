@@ -1,0 +1,102 @@
+"""Query safety rails: deadlines, admission control, memory accounting.
+
+Reference parity (SURVEY.md 5.2): Pinot's query-killing memory accountant
+(PerQueryCPUMemAccountantFactory / ResourceManager heap protection), query
+timeouts (ServerQueryExecutorV1Impl timeout checks between operator calls),
+and scheduler admission (ResourceManager semaphores).
+
+Re-design: the unit of work between checks is one SEGMENT KERNEL (the jitted
+call), so the deadline is tested between segment launches — the same
+granularity the reference gets between operator `nextBlock` calls.  Memory
+admission is an up-front estimate of device bytes the plan will touch
+(columns shipped + group tables), charged against a process-wide budget
+while the query runs — an estimate-ahead variant of the reference's
+sampling accountant (no mid-flight kill needed: XLA allocations are
+per-kernel and bounded by the estimate).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from pinot_tpu.query.ir import QueryContext
+
+
+class QueryTimeoutError(RuntimeError):
+    pass
+
+
+class AdmissionError(RuntimeError):
+    pass
+
+
+class Deadline:
+    __slots__ = ("expires_at", "timeout_ms")
+
+    def __init__(self, timeout_ms: Optional[float]):
+        self.timeout_ms = timeout_ms
+        self.expires_at = time.perf_counter() + timeout_ms / 1000 if timeout_ms else None
+
+    @staticmethod
+    def from_ctx(ctx: QueryContext) -> "Deadline":
+        t = ctx.options.get("timeoutMs")
+        return Deadline(float(t) if t is not None else None)
+
+    def check(self, what: str = "query") -> None:
+        if self.expires_at is not None and time.perf_counter() > self.expires_at:
+            raise QueryTimeoutError(f"{what} exceeded timeoutMs={self.timeout_ms:g}")
+
+
+def estimate_segment_bytes(ctx: QueryContext, segment, needed_columns: Optional[List[str]] = None) -> int:
+    """Device bytes one segment's kernel will touch: shipped column arrays
+    plus the group-table output (the two allocations that scale)."""
+    total = 0
+    names = needed_columns if needed_columns is not None else segment.column_names
+    for name in names:
+        if name not in segment.columns:
+            continue
+        c = segment.columns[name]
+        arr = c.codes if c.codes is not None else c.values
+        if arr is not None:
+            total += arr.nbytes
+        if c.nulls is not None:
+            total += c.nulls.nbytes // 8
+    if ctx.group_by:
+        total += int(ctx.num_groups_limit) * 16 * max(1, len(ctx.aggregations))
+    return total
+
+
+class MemoryAccountant:
+    """Process-wide device-memory admission (budget in bytes).
+
+    acquire() admits a query's estimate or raises AdmissionError — queries
+    never start work they can't finish (the reference instead kills the
+    largest query under heap pressure; with static shapes we can refuse
+    up front)."""
+
+    def __init__(self, budget_bytes: int = 8 << 30):
+        self.budget = budget_bytes
+        self.in_use = 0
+        self._lock = threading.Lock()
+        self._by_query: Dict[int, int] = {}
+        self._next_id = 0
+
+    def acquire(self, nbytes: int, what: str = "query") -> int:
+        with self._lock:
+            if self.in_use + nbytes > self.budget:
+                raise AdmissionError(
+                    f"{what} needs ~{nbytes / 1e6:.1f} MB device memory; "
+                    f"{(self.budget - self.in_use) / 1e6:.1f} MB of {self.budget / 1e6:.1f} MB available "
+                    "(raise the accountant budget or lower numGroupsLimit/query width)"
+                )
+            self._next_id += 1
+            qid = self._next_id
+            self._by_query[qid] = nbytes
+            self.in_use += nbytes
+            return qid
+
+    def release(self, qid: int) -> None:
+        with self._lock:
+            n = self._by_query.pop(qid, 0)
+            self.in_use -= n

@@ -93,6 +93,7 @@ KEYWORDS = {
     "true", "false", "filter", "option",
     "join", "on", "inner", "left", "right", "full", "cross", "outer",
     "over", "partition", "union", "intersect", "except", "all",
+    "explain", "plan", "for",
 }
 
 
@@ -194,6 +195,12 @@ class _Parser:
     # -- entry -----------------------------------------------------------
     def parse(self) -> QueryContext:
         options = {}
+        # EXPLAIN PLAN FOR SELECT ... (Pinot explain syntax)
+        if self.at_kw("explain"):
+            self.advance()
+            self.expect_kw("plan")
+            self.expect_kw("for")
+            options["__explain__"] = True
         # Pinot option prelude: SET key = value; ... SELECT ...
         while self.at_kw("set"):
             self.advance()

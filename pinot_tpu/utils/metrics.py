@@ -1,0 +1,161 @@
+"""Process-wide metrics registry (ServerMetrics/BrokerMetrics analog,
+pinot-common/.../metrics/ — meters, gauges and timers keyed by name).
+
+Re-design: one lock-free-enough registry of counters/gauges/timers with a
+snapshot() export instead of yammer/dropwizard plumbing; emitters call
+METRICS.counter("queries").inc() on the hot path (dict lookups only).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+class Timer:
+    """Count + total + max milliseconds (the useful aggregate slice of a
+    latency histogram without per-query allocation)."""
+
+    __slots__ = ("count", "total_ms", "max_ms")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+
+    def update(self, ms: float) -> None:
+        self.count += 1
+        self.total_ms += ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+
+    @property
+    def mean_ms(self) -> float:
+        return self.total_ms / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._timers: Dict[str, Timer] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter())
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge())
+        return g
+
+    def timer(self, name: str) -> Timer:
+        t = self._timers.get(name)
+        if t is None:
+            with self._lock:
+                t = self._timers.setdefault(name, Timer())
+        return t
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "counters": {k: c.value for k, c in self._counters.items()},
+            "gauges": {k: g.value for k, g in self._gauges.items()},
+            "timers": {
+                k: {"count": t.count, "meanMs": t.mean_ms, "maxMs": t.max_ms}
+                for k, t in self._timers.items()
+            },
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+
+
+METRICS = MetricsRegistry()
+
+
+class Span:
+    """One trace span (RequestContext/tracing analog, SURVEY.md 5.1)."""
+
+    __slots__ = ("name", "start", "duration_ms", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.start = time.perf_counter()
+        self.duration_ms = 0.0
+        self.children: List["Span"] = []
+
+    def close(self) -> None:
+        self.duration_ms = (time.perf_counter() - self.start) * 1000
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "ms": round(self.duration_ms, 3)}
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class Trace:
+    """Span-tree builder: `with trace.span("plan"): ...`; no-ops when
+    disabled so the hot path pays one attribute check."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self.root = Span("query") if enabled else None
+        self._stack = [self.root] if enabled else []
+
+    class _Ctx:
+        def __init__(self, trace: "Trace", name: str):
+            self.trace = trace
+            self.name = name
+            self.sp = None
+
+        def __enter__(self):
+            if self.trace.enabled:
+                self.sp = Span(self.name)
+                self.trace._stack[-1].children.append(self.sp)
+                self.trace._stack.append(self.sp)
+            return self.sp
+
+        def __exit__(self, *exc):
+            if self.sp is not None:
+                self.sp.close()
+                self.trace._stack.pop()
+            return False
+
+    def span(self, name: str) -> "Trace._Ctx":
+        return Trace._Ctx(self, name)
+
+    def finish(self):
+        if self.root is not None:
+            self.root.close()
+            return self.root.to_dict()
+        return None

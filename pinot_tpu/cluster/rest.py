@@ -1,0 +1,151 @@
+"""HTTP query endpoint: the broker REST surface.
+
+Reference parity: Pinot's broker query REST (POST /query/sql handled by
+BaseSingleStageBrokerRequestHandler) + cursor endpoints + /health and
+/metrics.  Re-design: stdlib http.server on a daemon thread serving an
+in-process QueryEngine or cluster Broker — the data plane stays in-process
+(SURVEY.md §2.6); this surface exists for clients/tools parity.
+
+Response shape follows BrokerResponse: {"resultTable": {"dataSchema":
+{"columnNames": [...]}, "rows": [...]}, "numDocsScanned": ..., ...}.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from pinot_tpu.query.cursors import ResponseStore
+from pinot_tpu.query.result import ResultTable
+from pinot_tpu.utils.metrics import METRICS
+
+
+def _jsonable(v):
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, float) and (v != v or v in (float("inf"), float("-inf"))):
+        return None
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+def broker_response(result: ResultTable) -> Dict[str, Any]:
+    s = result.stats
+    return {
+        "resultTable": {
+            "dataSchema": {"columnNames": list(result.columns)},
+            "rows": [[_jsonable(v) for v in row] for row in result.rows],
+        },
+        "numRowsResultSet": len(result.rows),
+        "numDocsScanned": s.num_docs_scanned,
+        "numSegmentsQueried": s.num_segments_queried,
+        "numSegmentsPruned": s.num_segments_pruned,
+        "numSegmentsProcessed": s.num_segments_processed,
+        "totalDocs": s.total_docs,
+        "timeUsedMs": round(s.time_ms, 3),
+        "trace": s.trace,
+        "exceptions": [],
+    }
+
+
+class QueryServer:
+    """Serves one engine-like object (anything with .sql or .query)."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+        self.engine = engine
+        self.cursors = ResponseStore()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    if self.path == "/health":
+                        self._send(200, {"status": "OK"})
+                    elif self.path == "/metrics":
+                        self._send(200, METRICS.snapshot())
+                    elif self.path.startswith("/cursors/"):
+                        parts = self.path.strip("/").split("/")
+                        cid = parts[1]
+                        page = int(parts[2]) if len(parts) > 2 else 0
+                        self._send(200, outer.cursors.fetch(cid, page))
+                    else:
+                        self._send(404, {"error": f"unknown path {self.path}"})
+                except KeyError as e:
+                    self._send(404, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 - boundary
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    if self.path not in ("/query/sql", "/query"):
+                        self._send(404, {"error": f"unknown path {self.path}"})
+                        return
+                    sql = req.get("sql", "")
+                    run = getattr(outer.engine, "sql", None) or outer.engine.query
+                    result = run(sql)
+                    payload = broker_response(result)
+                    if req.get("useCursor"):
+                        cid = outer.cursors.register(result, int(req.get("pageSize", 1000)))
+                        payload["cursorId"] = cid
+                        payload["resultTable"]["rows"] = payload["resultTable"]["rows"][
+                            : int(req.get("pageSize", 1000))
+                        ]
+                    self._send(200, payload)
+                except Exception as e:  # noqa: BLE001 - boundary
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "QueryServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class PinotClient:
+    """Minimal python client over the REST surface (pinot-java-client /
+    pinotdb analog)."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def execute(self, sql: str, **kw) -> Dict[str, Any]:
+        import urllib.request
+
+        body = json.dumps({"sql": sql, **kw}).encode("utf-8")
+        req = urllib.request.Request(
+            self.url + "/query/sql", data=body, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def fetch_cursor(self, cursor_id: str, page: int) -> Dict[str, Any]:
+        import urllib.request
+
+        with urllib.request.urlopen(f"{self.url}/cursors/{cursor_id}/{page}") as resp:
+            return json.loads(resp.read().decode("utf-8"))

@@ -203,6 +203,32 @@ class QueryEngine:
         ctx = parse_query(sql)
         return self.execute(ctx, device=device)
 
+    def sql(self, statement: str, device=None) -> ResultTable:
+        """DDL + DML front door (the pinot-sql-ddl controller resource)."""
+        from pinot_tpu.sql.ddl import is_ddl, parse_ddl, show_create_table
+
+        if not is_ddl(statement):
+            return self.query(statement, device=device)
+        stmt = parse_ddl(statement)
+        if stmt.kind == "create_table":
+            self.register_table(stmt.schema, stmt.config)
+            return ResultTable(columns=["status"], rows=[(f"created {stmt.table}",)], stats=ExecutionStats())
+        if stmt.kind == "drop_table":
+            if stmt.table not in self.tables:
+                raise KeyError(f"table {stmt.table!r} not found")
+            del self.tables[stmt.table]
+            return ResultTable(columns=["status"], rows=[(f"dropped {stmt.table}",)], stats=ExecutionStats())
+        if stmt.kind == "show_tables":
+            return ResultTable(
+                columns=["tableName"], rows=[(n,) for n in sorted(self.tables)], stats=ExecutionStats()
+            )
+        state = self.table(stmt.table)
+        return ResultTable(
+            columns=["createTable"],
+            rows=[(show_create_table(state.schema, state.config),)],
+            stats=ExecutionStats(),
+        )
+
 
 # ---------------------------------------------------------------------------
 # Engine-agnostic rewrites (shared by QueryEngine / Broker / Distributed)

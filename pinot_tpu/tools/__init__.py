@@ -1,0 +1,1 @@
+"""Admin tools (pinot-tools PinotAdministrator analog)."""

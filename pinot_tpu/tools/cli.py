@@ -1,0 +1,160 @@
+"""CLI: the PinotAdministrator command tree, python-m style.
+
+Reference parity: pinot-tools/.../tools/admin/command/ (CreateSegment,
+PostQuery, StartServiceManager/quickstart commands).
+
+  python -m pinot_tpu.tools.cli create-segment --schema s.json --csv d.csv --out dir
+  python -m pinot_tpu.tools.cli query --segments dir1 dir2 --sql "SELECT ..."
+  python -m pinot_tpu.tools.cli serve --segments dir1 --port 8099
+  python -m pinot_tpu.tools.cli quickstart
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+
+def _load_schema(path: str):
+    from pinot_tpu.spi.schema import Schema
+
+    with open(path, "r", encoding="utf-8") as f:
+        return Schema.from_dict(json.load(f))
+
+
+def _load_config(path, name):
+    from pinot_tpu.spi.config import TableConfig
+
+    if path:
+        with open(path, "r", encoding="utf-8") as f:
+            return TableConfig.from_dict(json.load(f))
+    return TableConfig(name=name)
+
+
+def cmd_create_segment(args) -> int:
+    from pinot_tpu.ingest import read_csv_columns
+    from pinot_tpu.segment.builder import build_segment
+
+    schema = _load_schema(args.schema)
+    cfg = _load_config(args.table_config, schema.name)
+    cols = read_csv_columns(args.csv, schema=schema, delimiter=args.delimiter)
+    name = args.name or os.path.splitext(os.path.basename(args.csv))[0]
+    seg = build_segment(schema, cols, name, table_config=cfg, output_dir=args.out)
+    print(f"built segment {name}: {seg.num_docs} docs -> {args.out}")
+    return 0
+
+
+def _engine_for_segments(segment_dirs: List[str]):
+    from pinot_tpu.query.engine import QueryEngine
+    from pinot_tpu.segment.segment import ImmutableSegment
+
+    eng = QueryEngine()
+    for d in segment_dirs:
+        seg = ImmutableSegment.load(d)
+        if seg.table_name not in eng.tables:
+            eng.register_table(seg.schema)
+        eng.add_segment(seg.table_name, seg)
+    return eng
+
+
+def cmd_query(args) -> int:
+    eng = _engine_for_segments(args.segments)
+    res = eng.sql(args.sql)
+    print("\t".join(res.columns))
+    for row in res.rows:
+        print("\t".join(str(v) for v in row))
+    print(
+        f"-- {len(res.rows)} rows, {res.stats.num_docs_scanned} docs scanned, "
+        f"{res.stats.time_ms:.1f} ms",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from pinot_tpu.cluster.rest import QueryServer
+
+    eng = _engine_for_segments(args.segments)
+    server = QueryServer(eng, port=args.port).start()
+    print(f"query server listening on http://127.0.0.1:{server.port}/query/sql")
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def cmd_quickstart(args) -> int:
+    """In-memory demo: build a table, run example queries (quickstart analog)."""
+    import numpy as np
+
+    from pinot_tpu.query.engine import QueryEngine
+
+    eng = QueryEngine()
+    eng.sql(
+        "CREATE TABLE demo (city STRING, product STRING, amount DOUBLE METRIC, ts TIMESTAMP) "
+        "WITH (invertedIndexColumns = 'city', timeColumnName = 'ts')"
+    )
+    rng = np.random.default_rng(7)
+    n = 100_000
+    from pinot_tpu.segment.builder import build_segment
+
+    state = eng.table("demo")
+    data = {
+        "city": rng.choice(["sf", "nyc", "tokyo", "berlin"], n).astype(object),
+        "product": rng.choice(["a", "b", "c"], n).astype(object),
+        "amount": np.round(rng.random(n) * 100, 2),
+        "ts": 1_700_000_000_000 + rng.integers(0, 30 * 86_400_000, n),
+    }
+    eng.add_segment("demo", build_segment(state.schema, data, "demo_0", table_config=state.config))
+    for sql in [
+        "SELECT COUNT(*) FROM demo",
+        "SELECT city, SUM(amount) FROM demo GROUP BY city ORDER BY SUM(amount) DESC",
+        "SELECT product, COUNT(*) FROM demo WHERE city = 'sf' GROUP BY product",
+        "EXPLAIN PLAN FOR SELECT COUNT(*) FROM demo WHERE city = 'sf'",
+    ]:
+        print(f"\n> {sql}")
+        res = eng.sql(sql)
+        print("\t".join(res.columns))
+        for row in res.rows:
+            print("\t".join(str(v) for v in row))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="pinot_tpu", description="pinot_tpu admin CLI")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    c = sub.add_parser("create-segment", help="CSV -> immutable segment directory")
+    c.add_argument("--schema", required=True)
+    c.add_argument("--csv", required=True)
+    c.add_argument("--out", required=True)
+    c.add_argument("--table-config")
+    c.add_argument("--name")
+    c.add_argument("--delimiter", default=",")
+    c.set_defaults(fn=cmd_create_segment)
+
+    q = sub.add_parser("query", help="run SQL over segment directories")
+    q.add_argument("--segments", nargs="+", required=True)
+    q.add_argument("--sql", required=True)
+    q.set_defaults(fn=cmd_query)
+
+    s = sub.add_parser("serve", help="HTTP query endpoint over segment directories")
+    s.add_argument("--segments", nargs="+", required=True)
+    s.add_argument("--port", type=int, default=8099)
+    s.set_defaults(fn=cmd_serve)
+
+    qs = sub.add_parser("quickstart", help="in-memory demo table + example queries")
+    qs.set_defaults(fn=cmd_quickstart)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

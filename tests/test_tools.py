@@ -1,0 +1,164 @@
+"""Tools tests: DDL round-trip, REST endpoint + cursors, CLI, PinotFS."""
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+
+class TestDdl:
+    def test_create_show_drop(self):
+        eng = QueryEngine()
+        eng.sql(
+            "CREATE TABLE orders ("
+            "  city STRING,"
+            "  tags STRING MV,"
+            "  amount DOUBLE METRIC,"
+            "  updated_at TIMESTAMP,"
+            "  PRIMARY KEY (city)"
+            ") WITH (invertedIndexColumns = 'city', timeColumnName = 'updated_at', retentionDays = '30')"
+        )
+        tables = eng.sql("SHOW TABLES")
+        assert tables.rows == [("orders",)]
+        state = eng.table("orders")
+        assert state.schema.field("tags").single_value is False
+        assert state.config.indexing.inverted_index_columns == ["city"]
+        assert state.config.segments.retention_time_value == 30
+        assert state.schema.primary_key_columns == ["city"]
+        eng.sql("DROP TABLE orders")
+        assert eng.sql("SHOW TABLES").rows == []
+
+    def test_show_create_round_trip(self):
+        from pinot_tpu.sql.ddl import parse_ddl
+
+        eng = QueryEngine()
+        ddl = (
+            "CREATE TABLE rt (k STRING, v LONG METRIC, ts TIMESTAMP, PRIMARY KEY (k)) "
+            "WITH (upsertMode = 'FULL', comparisonColumn = 'ts', timeColumnName = 'ts', "
+            "streamType = 'memory', sortedColumn = 'k')"
+        )
+        eng.sql(ddl)
+        shown = eng.sql("SHOW CREATE TABLE rt").rows[0][0]
+        stmt = parse_ddl(shown)  # fixed point: re-parses to the same table
+        assert stmt.schema.to_dict() == eng.table("rt").schema.to_dict()
+        assert stmt.config.to_dict() == eng.table("rt").config.to_dict()
+
+    def test_ddl_then_query(self):
+        eng = QueryEngine()
+        eng.sql("CREATE TABLE t (city STRING, v LONG METRIC)")
+        state = eng.table("t")
+        rng = np.random.default_rng(3)
+        data = {"city": rng.choice(["a", "b"], 1000).astype(object), "v": rng.integers(0, 10, 1000)}
+        eng.add_segment("t", build_segment(state.schema, data, "s0", table_config=state.config))
+        res = eng.sql("SELECT city, SUM(v) FROM t GROUP BY city ORDER BY city")
+        assert len(res.rows) == 2
+
+
+class TestRestAndCursors:
+    @pytest.fixture()
+    def server(self):
+        from pinot_tpu.cluster.rest import QueryServer
+
+        eng = QueryEngine()
+        eng.sql("CREATE TABLE t (city STRING, v LONG METRIC)")
+        rng = np.random.default_rng(5)
+        data = {"city": rng.choice(["sf", "nyc"], 5000).astype(object), "v": rng.integers(0, 100, 5000)}
+        eng.add_segment("t", build_segment(eng.table("t").schema, data, "s0"))
+        srv = QueryServer(eng).start()
+        yield srv
+        srv.stop()
+
+    def test_query_endpoint(self, server):
+        from pinot_tpu.cluster.rest import PinotClient
+
+        client = PinotClient(f"http://127.0.0.1:{server.port}")
+        resp = client.execute("SELECT city, COUNT(*), SUM(v) FROM t GROUP BY city ORDER BY city")
+        assert resp["resultTable"]["dataSchema"]["columnNames"] == ["city", "count(*)", "sum(v)"]
+        assert len(resp["resultTable"]["rows"]) == 2
+        assert resp["numDocsScanned"] == 5000
+        assert resp["timeUsedMs"] > 0
+
+    def test_health_and_metrics(self, server):
+        import urllib.request
+
+        with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/health") as r:
+            assert json.loads(r.read())["status"] == "OK"
+        with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/metrics") as r:
+            snap = json.loads(r.read())
+            assert "counters" in snap
+
+    def test_error_payload(self, server):
+        from pinot_tpu.cluster.rest import PinotClient
+        import urllib.error
+
+        client = PinotClient(f"http://127.0.0.1:{server.port}")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client.execute("SELECT FROM nowhere")
+        assert ei.value.code == 500
+
+    def test_cursor_paging(self, server):
+        from pinot_tpu.cluster.rest import PinotClient
+
+        client = PinotClient(f"http://127.0.0.1:{server.port}")
+        resp = client.execute("SELECT city, v FROM t LIMIT 250", useCursor=True, pageSize=100)
+        cid = resp["cursorId"]
+        assert len(resp["resultTable"]["rows"]) == 100
+        p2 = client.fetch_cursor(cid, 2)
+        assert p2["totalRows"] == 250
+        assert p2["numPages"] == 3
+        assert len(p2["rows"]) == 50
+        all_rows = []
+        for page in range(p2["numPages"]):
+            all_rows.extend(client.fetch_cursor(cid, page)["rows"])
+        assert len(all_rows) == 250
+
+
+class TestCli:
+    def test_create_segment_and_query(self, tmp_path, capsys):
+        from pinot_tpu.tools.cli import main
+
+        schema = Schema(
+            "t",
+            [
+                FieldSpec("city", DataType.STRING),
+                FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            ],
+        )
+        sp = tmp_path / "schema.json"
+        sp.write_text(schema.to_json())
+        csv = tmp_path / "data.csv"
+        csv.write_text("city,v\n" + "\n".join(f"c{i % 3},{i}" for i in range(300)))
+        out = tmp_path / "seg"
+        assert main(["create-segment", "--schema", str(sp), "--csv", str(csv), "--out", str(out)]) == 0
+        assert main(["query", "--segments", str(out), "--sql", "SELECT COUNT(*), SUM(v) FROM t"]) == 0
+        got = capsys.readouterr().out
+        assert "300" in got and str(sum(range(300))) in got
+
+
+class TestPinotFS:
+    def test_local_fs_operations(self, tmp_path):
+        from pinot_tpu.spi.filesystem import LocalPinotFS, fs_for_uri
+
+        fs = fs_for_uri(str(tmp_path))
+        assert isinstance(fs, LocalPinotFS)
+        d = str(tmp_path / "a" / "b")
+        fs.mkdir(d)
+        f = str(tmp_path / "a" / "b" / "x.txt")
+        with open(f, "w") as fh:
+            fh.write("hello")
+        assert fs.exists(f) and fs.length(f) == 5
+        fs.copy(f, str(tmp_path / "a" / "y.txt"))
+        fs.move(str(tmp_path / "a" / "y.txt"), str(tmp_path / "z.txt"))
+        assert fs.exists(str(tmp_path / "z.txt"))
+        files = fs.list_files(str(tmp_path), recursive=True)
+        assert any(p.endswith("x.txt") for p in files)
+        assert fs.delete(str(tmp_path / "z.txt"))
+
+    def test_unknown_scheme(self):
+        from pinot_tpu.spi.filesystem import fs_for_uri
+
+        with pytest.raises(ValueError, match="no PinotFS registered"):
+            fs_for_uri("s3://bucket/key")

@@ -1,0 +1,120 @@
+"""Real-TPU correctness assertions (round-2 verdict weak #8: exact-limb and
+bitmap claims were never asserted on the actual accelerator).
+
+The session conftest pins tests to the virtual CPU mesh, so these run the
+kernels in a SUBPROCESS that inherits the ambient JAX platform (the axon
+TPU relay when present) and skip when no accelerator is reachable.  One
+subprocess runs all assertions to pay the compile latency once.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_PROBE = """
+import jax, json
+devs = jax.devices()
+print(json.dumps({"platform": devs[0].platform, "n": len(devs)}))
+"""
+
+_ASSERTIONS = r"""
+import json
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pinot_tpu  # enables x64
+from pinot_tpu import ops
+from pinot_tpu.query import planner
+from pinot_tpu.query.functions import get_agg_function
+
+rng = np.random.default_rng(7)
+n, G = 200_000, 64
+out = {}
+
+# 1. chunked32 exact-limb grouped int SUM: bit-exact vs numpy int64
+codes = jnp.asarray(rng.integers(0, G, n).astype(np.int32))
+vals_np = rng.integers(-1_000_000, 1_000_000, n).astype(np.int32)
+vals = jnp.asarray(vals_np)
+mask = jnp.asarray(rng.random(n) < 0.7)
+got = np.asarray(jax.device_get(jax.jit(lambda v, m, c: ops.group_sum(v, m, c, G))(vals, mask, codes)))
+exp = np.zeros(G, dtype=np.int64)
+np.add.at(exp, np.asarray(codes), np.where(np.asarray(mask), vals_np.astype(np.int64), 0))
+assert np.array_equal(got.astype(np.int64), exp), "grouped int SUM not exact on this platform"
+out["group_sum_exact"] = True
+
+# 2. masked_sum exact-limb scalar path
+got_s = float(jax.device_get(jax.jit(ops.masked_sum)(vals, mask)))
+exp_s = float(np.where(np.asarray(mask), vals_np.astype(np.int64), 0).sum())
+assert got_s == exp_s, (got_s, exp_s)
+out["masked_sum_exact"] = True
+
+# 3. bitmap word unpack: device bit math == numpy unpackbits
+words_np = rng.integers(0, 2**32, 2048, dtype=np.uint64).astype(np.uint32)
+def unpack(words, n_docs):
+    docs = jnp.arange(n_docs, dtype=jnp.int32)
+    w = words[docs >> 5]
+    return ((w >> (docs & 31).astype(jnp.uint32)) & jnp.uint32(1)).astype(bool)
+got_b = np.asarray(jax.device_get(jax.jit(unpack, static_argnums=1)(jnp.asarray(words_np), 2048*32)))
+exp_b = np.unpackbits(words_np.view(np.uint8), bitorder="little").astype(bool)
+assert np.array_equal(got_b, exp_b), "bitmap unpack mismatch"
+out["bitmap_unpack_exact"] = True
+
+# 4. sparse group-by sort kernel: tables match a host groupby
+key_np = rng.integers(0, 5000, n).astype(np.int64)
+sum_fn = get_agg_function("sum")
+def sparse(vals, mask, key):
+    return planner.sparse_grouped_tables([sum_fn], [(vals, mask)], mask, key, 6000)
+uniq, partials = jax.device_get(jax.jit(sparse)(vals.astype(jnp.float64), mask, jnp.asarray(key_np)))
+uniq = np.asarray(uniq); present = uniq != planner.SPARSE_EMPTY_KEY
+hsum = {}
+for k, v, m in zip(key_np, vals_np, np.asarray(mask)):
+    if m: hsum[k] = hsum.get(k, 0.0) + float(v)
+got_map = {int(k): float(s) for k, s in zip(uniq[present], np.asarray(partials[0]["sum"])[present])}
+assert got_map == hsum, "sparse group tables mismatch"
+out["sparse_groupby_exact"] = True
+
+print(json.dumps(out))
+"""
+
+
+def _run(code: str, timeout: int = 300):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # inherit the ambient accelerator
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+@pytest.fixture(scope="module")
+def accelerator():
+    try:
+        probe = _run(_PROBE, timeout=120)
+    except subprocess.TimeoutExpired:
+        pytest.skip("platform probe timed out")
+    if probe.returncode != 0:
+        pytest.skip(f"no ambient JAX platform: {probe.stderr[-200:]}")
+    info = json.loads(probe.stdout.strip().splitlines()[-1])
+    if info["platform"] in ("cpu",):
+        pytest.skip("no accelerator attached (ambient platform is cpu)")
+    return info
+
+
+def test_kernel_exactness_on_accelerator(accelerator):
+    """chunked32 limb sums, bitmap unpack, and the sparse sort kernel are
+    bit-exact ON THE REAL ACCELERATOR, not just the CPU mesh."""
+    res = _run(_ASSERTIONS, timeout=580)
+    assert res.returncode == 0, f"TPU assertions failed:\n{res.stderr[-2000:]}"
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out == {
+        "group_sum_exact": True,
+        "masked_sum_exact": True,
+        "bitmap_unpack_exact": True,
+        "sparse_groupby_exact": True,
+    }

@@ -1,0 +1,20 @@
+"""Timeseries query engine (pinot-timeseries analog)."""
+from pinot_tpu.timeseries.engine import (
+    FetchNode,
+    SeriesAggregateNode,
+    TimeBuckets,
+    TimeSeriesBlock,
+    TimeSeriesEngine,
+    TransformNode,
+    parse_pipeline,
+)
+
+__all__ = [
+    "FetchNode",
+    "SeriesAggregateNode",
+    "TimeBuckets",
+    "TimeSeriesBlock",
+    "TimeSeriesEngine",
+    "TransformNode",
+    "parse_pipeline",
+]

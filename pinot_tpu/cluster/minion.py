@@ -138,7 +138,13 @@ class MinionTaskManager:
         for d in dims:
             out[d] = np.asarray(data[d])[sel]
         for m in metrics:
-            vals = np.asarray(data[m], dtype=np.float64)
+            raw = data[m]
+            # nullable metrics: None/NaN contribute 0, matching SUM's
+            # ignore-nulls semantics (NaN would poison the whole group)
+            vals = np.array(
+                [0.0 if v is None or (isinstance(v, float) and v != v) else float(v) for v in raw],
+                dtype=np.float64,
+            )
             out[m] = np.bincount(inverse, weights=vals, minlength=len(sel))
         return out
 

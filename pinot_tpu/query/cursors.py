@@ -31,6 +31,7 @@ class ResponseStore:
 
     def fetch(self, cursor_id: str, page: int) -> Dict:
         with self._lock:
+            self._evict_locked()  # TTL applies on read too, not just register
             entry = self._store.get(cursor_id)
         if entry is None:
             raise KeyError(f"cursor {cursor_id!r} not found (expired or never created)")

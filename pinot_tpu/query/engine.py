@@ -60,6 +60,10 @@ class QueryEngine:
 
     # -- execution -------------------------------------------------------
     def execute(self, ctx: QueryContext, device=None) -> ResultTable:
+        if ctx.options.get("__explain__"):
+            # explain never executes anything — not subqueries, not set-op
+            # components (review-caught: per-component explains would union)
+            return self._explain(ctx, self.table(ctx.table).query_segments())
         resolve_subqueries(ctx, lambda c: self.execute(c, device=device))
         if ctx.set_ops:
             return apply_set_ops(ctx, lambda c: self.execute(c, device=device))
@@ -78,11 +82,14 @@ class QueryEngine:
         METRICS.counter("queries").inc()
         state = self.table(ctx.table)
         segments = state.query_segments()
-        if ctx.options.get("__explain__"):
-            return self._explain(ctx, segments)
         self._inject_global_ranges(ctx, state, segments)
-        # admission: charge the estimated device bytes up front (safety.py)
-        est = sum(estimate_segment_bytes(ctx, seg) for seg in segments)
+        # admission: charge the estimated device bytes up front (safety.py),
+        # counting only the columns the query actually ships
+        from pinot_tpu.query.planner import _needed_columns
+
+        est = sum(
+            estimate_segment_bytes(ctx, seg, _needed_columns(ctx, seg)) for seg in segments
+        )
         qid = self.accountant.acquire(est)
         stats = ExecutionStats()
         results = []

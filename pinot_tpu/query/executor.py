@@ -266,11 +266,14 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
 
     out_keys: List[str] = []
     items = plan.select_exprs or [planner.Expr.col(n) for n in plan.select_columns]
+    # window keys are indexed by position in ctx.select_list (what reduce
+    # enumerates), NOT the *-expanded items index
+    win_positions = iter(i for i, s in enumerate(ctx.select_list) if isinstance(s, WindowSpec))
     for i, e in enumerate(items):
         if isinstance(e, WindowSpec):
             # placeholder output slot (reduce overwrites after the global
             # merge) + the window's input arrays keyed by expr fingerprint
-            key = f"__win{i}"
+            key = f"__win{next(win_positions)}"
             out_keys.append(key)
             arrays[key] = np.zeros(len(docids))
             for ie in list(e.partition_by) + [o.expr for o in e.order_by] + ([e.expr] if e.expr else []):

@@ -221,8 +221,8 @@ def _reduce_selection(ctx: QueryContext, results: List[SelectionSegmentResult], 
     cols = results[0].columns
     if "*" in out_names:
         # SELECT *: label with the actual gathered columns so dataSchema
-        # matches the row arity
-        out_names = [c for c in cols if not c.startswith("__ord")]
+        # matches the row arity (window inputs/order keys are internal)
+        out_names = [c for c in cols if not (c.startswith("__ord") or c.startswith("__wx_"))]
     arrays = {
         c: np.concatenate([np.asarray(r.arrays[c], dtype=object) for r in results])
         if len(results) > 1

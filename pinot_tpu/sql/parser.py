@@ -211,14 +211,22 @@ class _Parser:
             options[str(name)] = self.literal_value()
             self.expect_op(";")
         ctx = self.select_statement(options)
-        # set operations: SELECT ... UNION [ALL] SELECT ... (left-assoc)
+        # set operations: INTERSECT binds tighter than UNION/EXCEPT (SQL
+        # standard); `a UNION b INTERSECT c` = a UNION (b INTERSECT c).
+        # Tight ops fold into the PRECEDING term's own set_ops; loose ops
+        # chain left-associatively at the top level.
+        last_term = ctx
         while self.at_kw("union", "intersect", "except"):
             op = self.advance().value
             all_flag = self.accept_kw("all")
             if all_flag and op != "union":
                 self.fail(f"{op.upper()} ALL is not supported")
             rhs = self.select_statement(dict(options))
-            ctx.set_ops.append((op, all_flag, rhs))
+            if op == "intersect" and last_term is not ctx:
+                last_term.set_ops.append((op, all_flag, rhs))
+            else:
+                ctx.set_ops.append((op, all_flag, rhs))
+                last_term = rhs
         self.accept_op(";")
         if self.cur.kind != "eof":
             self.fail("unexpected trailing input")

@@ -93,8 +93,7 @@ class TimeSeriesEngine:
     # -- leaf: SQL group-by over (tags, bucketed time) -------------------
     def _fetch(self, node: FetchNode, b: TimeBuckets) -> TimeSeriesBlock:
         tc = node.time_column
-        bucket_expr = f"({tc} - {b.start_ms}) / {b.step_ms}" if b.start_ms else f"{tc} / {b.step_ms}"
-        # integer division via arithmetic the expression group-by can bound:
+        # integer bucketing via arithmetic the expression group-by can bound:
         # (ts - start) - MOD(ts - start, step) is the bucket START offset
         off = f"({tc} - {b.start_ms})"
         bucket_expr = f"{off} - MOD({off}, {b.step_ms})"

@@ -130,3 +130,42 @@ class TestSemiJoin:
         eng, conn = env
         sql = "SELECT COUNT(*) FROM t WHERE dept IN (SELECT dept FROM t WHERE v > 10000000)"
         assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall())
+
+
+class TestReviewRegressions:
+    """Round-4 review findings pinned."""
+
+    def test_star_plus_window(self, env):
+        """SELECT * alongside a window function: correct values, no internal
+        column leakage (review finding: placeholder index mismatch)."""
+        eng, conn = env
+        sql = "SELECT *, ROW_NUMBER() OVER (ORDER BY v DESC) FROM t WHERE v > 9990 ORDER BY v DESC LIMIT 40"
+        res = eng.query(sql)
+        assert not any(c.startswith("__wx") for c in res.columns)
+        expected = conn.execute(
+            "SELECT city, dept, v, score, ROW_NUMBER() OVER (ORDER BY v DESC) FROM t WHERE v > 9990 ORDER BY v DESC LIMIT 40"
+        ).fetchall()
+        assert_same_rows(res.rows, expected, ordered=True)
+
+    def test_intersect_binds_tighter_than_union(self, env):
+        eng, conn = env
+        p = (
+            "SELECT dept FROM t WHERE city = 'sf' LIMIT 100000 "
+            "UNION SELECT dept FROM t WHERE city = 'nyc' LIMIT 100000 "
+            "INTERSECT SELECT dept FROM t WHERE v > 9995 LIMIT 100000"
+        )
+        # sqlite itself is left-associative (non-standard), so nest the
+        # golden explicitly: a UNION (b INTERSECT c)
+        expected = conn.execute(
+            "SELECT dept FROM t WHERE city = 'sf' "
+            "UNION SELECT * FROM (SELECT dept FROM t WHERE city = 'nyc' "
+            "INTERSECT SELECT dept FROM t WHERE v > 9995)"
+        ).fetchall()
+        assert_same_rows(eng.query(p).rows, expected)
+
+    def test_explain_with_set_ops_is_one_plan(self, env):
+        eng, conn = env
+        res = eng.query("EXPLAIN PLAN FOR SELECT city FROM t WHERE v > 10 LIMIT 5 UNION SELECT city FROM t LIMIT 5")
+        assert res.columns == ["Operator", "Operator_Id", "Parent_Id"]
+        ids = [r[1] for r in res.rows]
+        assert len(ids) == len(set(ids))  # one coherent plan, not a union of two

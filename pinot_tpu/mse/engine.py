@@ -37,7 +37,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pinot_tpu.mse import exchange as ex
-from pinot_tpu.mse.join import KEY_SENTINEL, lookup_join
+from pinot_tpu.mse.join import KEY_SENTINEL, lookup_join, range_join
 from pinot_tpu.mse.plan import JoinPlanError, ResolvedQuery, resolve
 from pinot_tpu.parallel.engine import (
     _psum_field,
@@ -64,6 +64,18 @@ from types import SimpleNamespace
 _INT_KEY_TYPES = (DataType.INT, DataType.LONG, DataType.TIMESTAMP, DataType.BOOLEAN)
 
 
+def _max_multiplicity(dim_st, dcol) -> int:
+    """Max repeats of one key in the build column (flat order = input order,
+    padding at the tail)."""
+    arr = dcol.codes if dcol.has_dictionary else dcol.values
+    flat = np.asarray(arr).reshape(-1)[: dim_st.num_docs]
+    if dcol.has_dictionary:
+        counts = np.bincount(flat.astype(np.int64), minlength=dcol.dictionary.cardinality)
+    else:
+        _, counts = np.unique(flat, return_counts=True)
+    return int(counts.max()) if len(counts) else 1
+
+
 @dataclass
 class _JoinPlan:
     """Compile-time recipe for one join stage."""
@@ -75,6 +87,9 @@ class _JoinPlan:
     build_key_fn: Callable  # (dim_cols) -> int64 keys
     probe_key_fn: Callable  # (fact_cols, params) -> int64 keys
     attrs: List[str]  # dim columns gathered through the join
+    # max build-key multiplicity (1 = unique PK join; >1 = bounded M:N
+    # expansion via range_join — see mse/join.py)
+    max_dup: int = 1
 
 
 @dataclass
@@ -189,6 +204,19 @@ class MultiStageEngine:
                 "hash-shuffle joins partition fact rows by one key; multi-join "
                 "queries must use the broadcast strategy"
             )
+        # many-to-many build sides need the broadcast expansion path
+        def _dup(j) -> bool:
+            dcol = self.tables[j.table].column(j.dim_key)
+            distinct = dcol.dictionary.cardinality if dcol.has_dictionary else dcol.stats.cardinality
+            return distinct < self.tables[j.table].num_docs
+
+        if any(_dup(j) for j in rq.joins):
+            if opt == "shuffle":
+                raise NotImplementedError(
+                    "many-to-many joins ride the broadcast expansion; joinStrategy='shuffle' "
+                    "requires unique build keys"
+                )
+            return "broadcast"
         if opt in ("broadcast", "shuffle"):
             return str(opt)
         if len(rq.joins) > 1:
@@ -208,12 +236,18 @@ class MultiStageEngine:
         dcol = dim_st.column(j.dim_key)
 
         distinct = dcol.dictionary.cardinality if dcol.has_dictionary else dcol.stats.cardinality
+        max_dup = 1
         if distinct < dim_st.num_docs:
-            raise NotImplementedError(
-                f"join build side {j.table}.{j.dim_key} has duplicate keys "
-                f"({distinct} distinct / {dim_st.num_docs} rows); only unique-key "
-                "(dimension primary key) joins are supported"
-            )
+            # many-to-many: bound the expansion by the true max multiplicity
+            # (host-side, unfiltered — a safe static upper bound)
+            max_dup = _max_multiplicity(dim_st, dcol)
+            cap = int(rq.ctx.options.get("joinMaxDup", 64))
+            if max_dup > cap:
+                raise NotImplementedError(
+                    f"join build side {j.table}.{j.dim_key} has keys repeated up to "
+                    f"{max_dup}x; the static expansion is capped at joinMaxDup={cap} "
+                    "(raise the option or pre-aggregate the build side)"
+                )
 
         fname, dname = j.fact_key, j.dim_key
         string_like = dcol.data_type.is_string_like or fcol.data_type.is_string_like
@@ -268,7 +302,9 @@ class MultiStageEngine:
                 k = _inner(dcols)
                 return jnp.where(dcols[_d]["nulls"], KEY_SENTINEL, k)
 
-        return _JoinPlan(j.table, j.join_type, fname, dname, build_key, probe_key, attrs=[])
+        return _JoinPlan(
+            j.table, j.join_type, fname, dname, build_key, probe_key, attrs=[], max_dup=max_dup
+        )
 
     def _dim_group_dim(
         self, expr: Expr, table: str, left_join: bool, null_handling: bool
@@ -454,6 +490,17 @@ class MultiStageEngine:
 
         slack = float(ctx.options.get("shuffleSlack", 2.0))
 
+        # bounded M:N expansion (at most one non-unique build side)
+        dup_idxs = [i for i, jp in enumerate(join_plans) if jp.max_dup > 1]
+        if len(dup_idxs) > 1:
+            raise NotImplementedError(
+                "at most one join may have a many-to-many build side "
+                f"(got {len(dup_idxs)}); pre-aggregate the other build sides"
+            )
+        dup_idx = dup_idxs[0] if dup_idxs else None
+        if dup_idx is not None and strategy != "broadcast":
+            raise NotImplementedError("many-to-many joins require the broadcast strategy")
+
         # ------------------------------------------------------------------
         def shard_kernel(fact_cols, fact_valid, dim_cols_list, dim_valids, params):
             fcols = flatten_cols(fact_cols)
@@ -476,10 +523,18 @@ class MultiStageEngine:
                     for a in jp.attrs:
                         side[a] = attr_array(dcols, jp.dim_table, a)
                     g = ex.broadcast_rows(side, axis)
-                    brow, match = lookup_join(g["key"], g["ok"], jp.probe_key_fn(fcols, params))
-                    matches.append(match)
-                    if jp.join_type == "inner":
-                        probe_mask = probe_mask & match
+                    if i == dup_idx:
+                        # bounded M:N: [P, max_dup] expansion; validity folds
+                        # into exp_mask below, not the 1-D probe_mask
+                        brow, match = range_join(
+                            g["key"], g["ok"], jp.probe_key_fn(fcols, params), jp.max_dup
+                        )
+                        matches.append(match)
+                    else:
+                        brow, match = lookup_join(g["key"], g["ok"], jp.probe_key_fn(fcols, params))
+                        matches.append(match)
+                        if jp.join_type == "inner":
+                            probe_mask = probe_mask & match
                     for a in jp.attrs:
                         gathered[(i, a)] = g[a][brow]
             else:  # hash shuffle
@@ -524,6 +579,23 @@ class MultiStageEngine:
                     for a in jp.attrs:
                         gathered[(i, a)] = drecv[a][brow]
 
+            # -- M:N expansion mask ([P, D] slot validity) -----------------
+            exp_mask = None
+            if dup_idx is not None:
+                D = join_plans[dup_idx].max_dup
+                m2 = matches[dup_idx]
+                if join_plans[dup_idx].join_type == "left":
+                    # LEFT with zero matches: one surviving slot (0) carrying
+                    # the null dim code
+                    nomatch = ~jnp.any(m2, axis=1)
+                    slot0 = jnp.arange(D) == 0
+                    m2 = m2 | (nomatch[:, None] & slot0[None, :])
+                exp_mask = probe_mask[:, None] & m2
+
+            def _expand_rows(v):
+                """[P] row array -> flat [P*D] under the expansion."""
+                return jnp.broadcast_to(v[:, None], exp_mask.shape).reshape(-1)
+
             # -- aggregate ------------------------------------------------
             if strategy == "broadcast":
                 inputs = agg_inputs_fn(fcols, params["fact"], probe_mask)
@@ -532,6 +604,18 @@ class MultiStageEngine:
                     (probe_cols[f"av{ai}"], probe_cols[f"am{ai}"] & probe_mask)
                     for ai in range(len(agg_specs))
                 ]
+            if exp_mask is not None:
+                flat_exp = exp_mask.reshape(-1)
+                inputs = [
+                    (
+                        _expand_rows(jnp.broadcast_to(v, probe_mask.shape)),
+                        _expand_rows(m) & flat_exp,
+                    )
+                    for v, m in inputs
+                ]
+                tmask = flat_exp
+            else:
+                tmask = probe_mask
 
             if kind == "aggregation":
                 partials = [fn.partial(v, m) for fn, (v, m) in zip(aggs, inputs)]
@@ -548,6 +632,8 @@ class MultiStageEngine:
                         code = fact_group_code(gd, fcols)
                     else:
                         code = probe_cols[f"g{gi}"]
+                    if exp_mask is not None:
+                        code = _expand_rows(code)
                 else:
                     code = group_code(gd, gathered[(di, gd.expr.op)])
                     match = matches[di]
@@ -559,10 +645,12 @@ class MultiStageEngine:
                             code = jnp.where(code == jnp.int32(ph), jnp.int32(gd.null_code), code)
                     else:
                         code = jnp.where(match, code, jnp.int32(0))
+                    if exp_mask is not None:
+                        code = code.reshape(-1) if di == dup_idx else _expand_rows(code)
                 code = jnp.clip(code, 0, gd.cardinality - 1)
                 key = code if key is None else key * jnp.int32(gd.cardinality) + code
             presence, partials = planner_mod.grouped_partials(
-                aggs, inputs, probe_mask, key, num_groups, vranges
+                aggs, inputs, tmask, key, num_groups, vranges
             )
             presence = lax.psum(presence, axis)
             partials = [

@@ -7,9 +7,15 @@ key->rows hash table from the right input, probe with left rows).
 Re-design: a TPU has no pointer-chasing hash table, but a sort plus binary
 search IS a perfect hash for static shapes: sort the (filtered) build keys
 once, then `searchsorted` every probe key in parallel — O(B log B + P log B)
-of pure vector work that XLA maps onto the VPU.  Build keys must be UNIQUE
-among valid rows (dimension-table primary keys — the star-schema case; the
-planner rejects many-to-many joins up front).
+of pure vector work that XLA maps onto the VPU.
+
+Two variants: lookup_join for UNIQUE build keys (dimension primary keys,
+one matched row per probe), and range_join for bounded many-to-many — the
+planner computes the build side's MAX key multiplicity host-side (static)
+and each probe returns up to max_dup matched rows as a [P, max_dup]
+expansion.  The reference's hash join materializes variable-length match
+lists; the static-shape analog pays max_dup slots for every probe row,
+which is the TPU trade (dense over dynamic) and why the planner caps it.
 """
 from __future__ import annotations
 
@@ -37,4 +43,28 @@ def lookup_join(
     pos = jnp.searchsorted(sorted_keys, probe_keys)
     cand = jnp.clip(pos, 0, sorted_keys.shape[0] - 1)
     match = (sorted_keys[cand] == probe_keys) & (probe_keys != KEY_SENTINEL)
+    return order[cand], match
+
+
+def range_join(
+    build_keys: jnp.ndarray,  # int64 [B]
+    build_valid: jnp.ndarray,  # bool [B]
+    probe_keys: jnp.ndarray,  # int64 [P]
+    max_dup: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Bounded many-to-many probe.
+
+    Returns (build_rows [P, max_dup], match [P, max_dup]): slot j holds the
+    j-th build row whose key equals the probe key (sorted run), match marks
+    real slots.  max_dup must be >= the true max multiplicity among valid
+    build rows (the planner computes it from the unfiltered column, a safe
+    upper bound)."""
+    sort_key = jnp.where(build_valid, build_keys, KEY_SENTINEL)
+    order = jnp.argsort(sort_key)
+    sorted_keys = sort_key[order]
+    lo = jnp.searchsorted(sorted_keys, probe_keys)  # first slot of the run
+    b = sorted_keys.shape[0]
+    offs = jnp.arange(max_dup, dtype=lo.dtype)
+    cand = jnp.clip(lo[:, None] + offs[None, :], 0, b - 1)
+    match = (sorted_keys[cand] == probe_keys[:, None]) & (probe_keys[:, None] != KEY_SENTINEL)
     return order[cand], match

@@ -202,25 +202,25 @@ def test_join_error_paths(engines):
             "SELECT x.d_year, COUNT(*) FROM lineorder JOIN dates ON lo_orderdate = d_datekey "
             "GROUP BY x.d_year"
         )
-    with pytest.raises(NotImplementedError):
-        # many-to-many: join fact to itself-like dup-key table
+    with pytest.raises(NotImplementedError, match="joinMaxDup"):
+        # many-to-many past the expansion cap (max multiplicity > 64)
         eng2 = DistributedEngine()
         rng = np.random.default_rng(0)
         s = Schema(name="dup", fields=[FieldSpec("k", DataType.INT), FieldSpec("v", DataType.INT)])
         eng2.register_table(
             "dup",
             StackedTable.build(
-                s, {"k": rng.integers(0, 5, 64), "v": np.arange(64)}, eng2.num_devices
+                s, {"k": rng.integers(0, 2, 640), "v": np.arange(640)}, eng2.num_devices
             ),
         )
         f = Schema(name="f", fields=[FieldSpec("fk", DataType.INT), FieldSpec("m", DataType.INT, role=FieldRole.METRIC)])
         eng2.register_table(
             "f",
             StackedTable.build(
-                f, {"fk": rng.integers(0, 5, 64), "m": np.arange(64)}, eng2.num_devices
+                f, {"fk": rng.integers(0, 2, 64), "m": np.arange(64)}, eng2.num_devices
             ),
         )
-        eng2.query("SELECT v, SUM(m) FROM f JOIN dup ON fk = k GROUP BY v")
+        eng2.query("SELECT COUNT(*), SUM(m) FROM f JOIN dup ON fk = k")
 
 
 def test_singletable_alias_qualifiers(engines):
@@ -327,3 +327,120 @@ def test_shuffle_overflow_raises(engines):
             "SELECT d_year, SUM(lo_revenue) FROM lineorder "
             "JOIN dates ON lo_orderdate = d_datekey GROUP BY d_year"
         )
+
+
+# ---------------------------------------------------------------------------
+# Bounded many-to-many joins (range_join expansion, round 4)
+# ---------------------------------------------------------------------------
+def _mn_env(rng, n_fact=4000, n_keys=150):
+    """Fact + a build side whose keys repeat (order -> MULTIPLE shipments)."""
+    order_schema = Schema(
+        name="orders",
+        fields=[
+            FieldSpec("o_key", DataType.INT),
+            FieldSpec("o_rev", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+    orders = {
+        "o_key": rng.integers(0, n_keys, n_fact).astype(np.int64),
+        "o_rev": rng.integers(1, 1000, n_fact).astype(np.int64),
+    }
+    # shipments: each key appears 0..5 times, with a carrier attribute
+    reps = rng.integers(0, 6, n_keys)
+    s_keys = np.repeat(np.arange(n_keys), reps).astype(np.int64)
+    ship_schema = Schema(
+        name="shipments",
+        fields=[
+            FieldSpec("s_key", DataType.INT),
+            FieldSpec("s_carrier", DataType.STRING),
+        ],
+    )
+    shipments = {
+        "s_key": s_keys,
+        "s_carrier": rng.choice(["ups", "dhl", "fedex"], len(s_keys)),
+    }
+    return (order_schema, orders), (ship_schema, shipments)
+
+
+def _mn_sqlite(orders, shipments, sql):
+    con = sqlite3.connect(":memory:")
+    con.execute("CREATE TABLE orders (o_key, o_rev)")
+    con.execute("CREATE TABLE shipments (s_key, s_carrier)")
+    con.executemany(
+        "INSERT INTO orders VALUES (?,?)",
+        list(zip(*(np.asarray(orders[c]).tolist() for c in ("o_key", "o_rev")))),
+    )
+    con.executemany(
+        "INSERT INTO shipments VALUES (?,?)",
+        list(zip(*(np.asarray(shipments[c]).tolist() for c in ("s_key", "s_carrier")))),
+    )
+    rows = con.execute(sql).fetchall()
+    con.close()
+    return rows
+
+
+@pytest.fixture(scope="module")
+def mn_engines():
+    rng = np.random.default_rng(29)
+    (os_, orders), (ss, shipments) = _mn_env(rng)
+    eng = DistributedEngine()
+    eng.register_table("orders", StackedTable.build(os_, orders, eng.num_devices))
+    eng.register_table("shipments", StackedTable.build(ss, shipments, eng.num_devices))
+    return eng, orders, shipments
+
+
+class TestManyToManyJoin:
+    def test_inner_mn_aggregation(self, mn_engines):
+        """Each fact row contributes once PER matching build row."""
+        eng, orders, shipments = mn_engines
+        sql = (
+            "SELECT COUNT(*), SUM(o_rev) FROM orders "
+            "JOIN shipments ON o_key = s_key"
+        )
+        res = eng.query(sql + " LIMIT 10")
+        exp = _mn_sqlite(orders, shipments, sql)
+        assert (int(res.rows[0][0]), int(res.rows[0][1])) == (int(exp[0][0]), int(exp[0][1]))
+
+    def test_inner_mn_groupby_build_attr(self, mn_engines):
+        eng, orders, shipments = mn_engines
+        sql = (
+            "SELECT s_carrier, COUNT(*), SUM(o_rev) FROM orders "
+            "JOIN shipments ON o_key = s_key GROUP BY s_carrier ORDER BY s_carrier"
+        )
+        res = eng.query(sql + " LIMIT 10")
+        exp = _mn_sqlite(orders, shipments, sql)
+        got = [(r[0], int(r[1]), int(r[2])) for r in res.rows]
+        assert got == [(a, int(b), int(c)) for a, b, c in exp]
+
+    def test_left_mn_keeps_unmatched(self, mn_engines):
+        eng, orders, shipments = mn_engines
+        sql = (
+            "SELECT s_carrier, COUNT(*) FROM orders "
+            "LEFT JOIN shipments ON o_key = s_key GROUP BY s_carrier ORDER BY s_carrier"
+        )
+        res = eng.query(sql + " LIMIT 10")
+        exp = _mn_sqlite(orders, shipments, sql)
+        got = {(r[0], int(r[1])) for r in res.rows}
+        assert got == {(a, int(b)) for a, b in exp}
+
+    def test_mn_with_filters(self, mn_engines):
+        eng, orders, shipments = mn_engines
+        sql = (
+            "SELECT COUNT(*), SUM(o_rev) FROM orders "
+            "JOIN shipments ON o_key = s_key "
+            "WHERE o_rev > 500 AND s_carrier = 'ups'"
+        )
+        res = eng.query(sql + " LIMIT 10")
+        exp = _mn_sqlite(orders, shipments, sql)
+        got_cnt = int(res.rows[0][0])
+        assert got_cnt == int(exp[0][0])
+        if got_cnt:
+            assert int(res.rows[0][1]) == int(exp[0][1])
+
+    def test_shuffle_strategy_rejected_for_mn(self, mn_engines):
+        eng, _, _ = mn_engines
+        with pytest.raises(NotImplementedError, match="broadcast"):
+            eng.query(
+                "SET joinStrategy = 'shuffle'; "
+                "SELECT COUNT(*) FROM orders JOIN shipments ON o_key = s_key LIMIT 5"
+            )

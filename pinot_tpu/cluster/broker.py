@@ -136,6 +136,24 @@ class Broker:
                 stats.num_docs_scanned += sstats.num_docs_scanned
                 stats.total_docs += sstats.total_docs
                 stats.add_index_uses(sstats.filter_index_uses)
+        # realtime tables: sealed + consuming segments served from the
+        # coordinator-owned manager (the RealtimeTableDataManager view)
+        rt = self.coordinator.realtime.get(table)
+        if rt is not None:
+            from pinot_tpu.query import executor as sse_executor
+
+            for seg in rt.query_segments():
+                deadline.check(f"query on {table}")
+                stats.num_segments_queried += 1
+                stats.total_docs += seg.num_docs
+                if sse_executor.prune_segment(ctx, seg):
+                    stats.num_segments_pruned += 1
+                    continue
+                res, sstats = sse_executor.execute_segment(ctx, seg)
+                stats.num_segments_processed += 1
+                stats.num_docs_scanned += sstats.num_docs_scanned
+                stats.add_index_uses(sstats.filter_index_uses)
+                results.append(res)
         out = reduce_mod.reduce_results(ctx, results, stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         return out

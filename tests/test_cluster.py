@@ -183,3 +183,48 @@ class TestRetentionAndPruning:
         res = broker.query("SELECT COUNT(*) FROM t WHERE city = 'nyc'")
         assert res.rows[0][0] == 200
         assert res.stats.num_segments_pruned >= 1  # non-nyc partitions pruned broker-side
+
+
+class TestRealtimeInCluster:
+    def test_coordinator_owned_realtime_table(self, tmp_path):
+        """Broker serves a REALTIME table's sealed + consuming segments from
+        the coordinator-owned manager; RealtimeToOffline then drains it."""
+        from pinot_tpu.cluster.minion import MinionTaskManager
+        from pinot_tpu.realtime import InMemoryStream
+        from pinot_tpu.spi.config import StreamConfig
+
+        coord = Coordinator(replication=1)
+        coord.register_server(ServerInstance("s0"))
+        stream = InMemoryStream(1)
+        cfg = TableConfig(
+            name="rt",
+            segments=SegmentsConfig(time_column="ts"),
+            stream=StreamConfig(stream_type="memory", max_rows_per_segment=40),
+        )
+        schema = Schema(
+            "rt",
+            [
+                FieldSpec("city", DataType.STRING),
+                FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+            ],
+        )
+        mgr = coord.add_realtime_table(schema, cfg, str(tmp_path / "rt"), stream=stream)
+        t0 = 1_700_000_000_000
+        rows = [{"city": ["sf", "nyc"][i % 2], "v": i, "ts": t0 + i} for i in range(100)]
+        stream.publish_many(rows, partition=0)
+        assert coord.run_realtime_consumption() == 100
+        broker = Broker(coord)
+        res = broker.query("SELECT city, COUNT(*), SUM(v) FROM rt GROUP BY city ORDER BY city")
+        assert {r[0]: (r[1], r[2]) for r in res.rows} == {
+            "nyc": (50, sum(i for i in range(100) if i % 2)),
+            "sf": (50, sum(i for i in range(100) if i % 2 == 0)),
+        }
+        # drain sealed segments into the offline table via the minion task
+        report = MinionTaskManager(coord).run(
+            "RealtimeToOfflineSegmentsTask", "rt", realtime_manager=mgr, window_end_ms=t0 + 200
+        )
+        assert len(report["moved"]) == 2
+        total = broker.query("SELECT COUNT(*) FROM rt").rows[0][0]
+        offline = broker.query(f"SELECT COUNT(*) FROM {report['offlineTable']}").rows[0][0]
+        assert offline == 80 and total == 20  # consuming tail stays realtime

@@ -348,6 +348,10 @@ STRING_RESULT_DICT_FNS = frozenset(
 )
 
 
+# user-registered string-result dict functions (register_dict_function)
+_EXTRA_STRING_RESULT: set = set()
+
+
 def string_result(expr) -> bool:
     """Does this dictionary-function expression produce STRING values?
     (Routes between the derived-string host paths and numeric device
@@ -355,7 +359,38 @@ def string_result(expr) -> bool:
     if expr.op == "json_extract_scalar":
         lits = [a.value for a in expr.args if a.is_literal]
         return len(lits) >= 2 and str(lits[1]).upper() == "STRING"
-    return expr.op in STRING_RESULT_DICT_FNS
+    return expr.op in STRING_RESULT_DICT_FNS or expr.op in _EXTRA_STRING_RESULT
+
+
+# ---------------------------------------------------------------------------
+# Registration surface (FunctionRegistry analog,
+# pinot-common/.../function/FunctionRegistry.java:73 — user scalar UDFs)
+# ---------------------------------------------------------------------------
+def register_device_function(name: str, fn) -> None:
+    """Register a traced numeric function: fn(jnp_values, *literal_args) ->
+    jnp array.  Usable anywhere expressions evaluate (filters, aggregation
+    inputs, selection, GROUP BY via interval analysis if bounded)."""
+    DEVICE_FNS[name.lower()] = fn
+
+
+def register_dict_function(name: str, fn, string_result_fn: bool = False) -> None:
+    """Register a dictionary-domain function: fn(np values array,
+    *literal_args) -> derived np array (object for strings, typed for
+    numerics); the engine gathers derived[codes] on device."""
+    DICT_FNS[name.lower()] = fn
+    if string_result_fn:
+        _EXTRA_STRING_RESULT.add(name.lower())
+
+
+def list_functions() -> dict:
+    """Registered function names by execution domain (plus aggregations)."""
+    from pinot_tpu.query.functions import _REGISTRY
+
+    return {
+        "device": sorted(DEVICE_FNS),
+        "dictionary": sorted(DICT_FNS),
+        "aggregation": sorted(_REGISTRY),
+    }
 
 
 def is_dict_fn_expr(expr) -> bool:

@@ -165,3 +165,49 @@ class TestNumericExpressions:
         sql_p = "SELECT v - MOD(v, 100), COUNT(*) FROM ev GROUP BY v - MOD(v, 100) ORDER BY v - MOD(v, 100)"
         sql_l = "SELECT (v/100)*100 AS b, COUNT(*) FROM ev GROUP BY b ORDER BY b"
         assert_same_rows(eng.query(sql_p).rows, conn.execute(sql_l).fetchall(), ordered=True)
+
+
+class TestFunctionRegistry:
+    """FunctionRegistry analog: user scalar UDFs (round 4)."""
+
+    def test_register_device_function(self, eng, conn):
+        import jax.numpy as jnp
+
+        from pinot_tpu.query import scalar
+
+        scalar.register_device_function("clamp100", lambda v: jnp.minimum(v, 100))
+        sql_p = "SELECT SUM(CLAMP100(v)) FROM ev WHERE v > 90"
+        sql_l = "SELECT SUM(MIN(v, 100)) FROM ev WHERE v > 90"
+        from golden import assert_same_rows
+
+        assert_same_rows(eng.query(sql_p).rows, conn.execute(sql_l).fetchall())
+
+    def test_register_dict_function(self, eng, data):
+        import numpy as np
+
+        from pinot_tpu.query import scalar
+
+        scalar.register_dict_function(
+            "initials",
+            lambda values: np.array(
+                ["".join(w[0] for w in str(v).split()) for v in values], dtype=object
+            ),
+            string_result_fn=True,
+        )
+        res = eng.query("SELECT name, INITIALS(name) FROM ev LIMIT 30")
+        for row in res.rows:
+            assert row[1] == "".join(w[0] for w in row[0].split())
+        # and in a predicate (derived-string table path)
+        res2 = eng.query("SELECT COUNT(*) FROM ev WHERE INITIALS(name) = 'AS'")
+        expected = sum(
+            1 for v in data["name"] if "".join(w[0] for w in v.split()) == "AS"
+        )
+        assert res2.rows[0][0] == expected
+
+    def test_list_functions(self):
+        from pinot_tpu.query import scalar
+
+        fns = scalar.list_functions()
+        assert "datetrunc" in fns["device"]
+        assert "upper" in fns["dictionary"]
+        assert "percentilekll" in fns["aggregation"]

@@ -105,7 +105,9 @@ class Broker:
 
     def execute(self, ctx: QueryContext) -> ResultTable:
         from pinot_tpu.query.engine import apply_set_ops, resolve_subqueries
+        from pinot_tpu.spi.env import apply_env_defaults
 
+        apply_env_defaults(ctx.options)
         resolve_subqueries(ctx, self.execute)
         if ctx.set_ops:
             return apply_set_ops(ctx, self.execute)

@@ -46,6 +46,7 @@ class ServerInstance:
         (segment results, stats) — the DataTable the reference ships back."""
         stats = ExecutionStats()
         results = []
+        pending = []
         for name in seg_names:
             seg = self.get_segment(ctx.table, name)
             if seg is None:
@@ -55,7 +56,10 @@ class ServerInstance:
             if executor.prune_segment(ctx, seg):
                 stats.num_segments_pruned += 1
                 continue
-            res, seg_stats = executor.execute_segment(ctx, seg, device=self.device)
+            # pipelined: dispatch all kernels async, then drain (executor.py)
+            pending.append(executor.launch_segment(ctx, seg, device=self.device))
+        for st in pending:
+            res, seg_stats = executor.collect_segment(st)
             stats.num_segments_processed += 1
             stats.num_docs_scanned += seg_stats.num_docs_scanned
             stats.add_index_uses(seg_stats.filter_index_uses)

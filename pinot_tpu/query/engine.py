@@ -60,6 +60,9 @@ class QueryEngine:
 
     # -- execution -------------------------------------------------------
     def execute(self, ctx: QueryContext, device=None) -> ResultTable:
+        from pinot_tpu.spi.env import apply_env_defaults
+
+        apply_env_defaults(ctx.options)
         if ctx.options.get("__explain__"):
             # explain never executes anything — not subqueries, not set-op
             # components (review-caught: per-component explains would union)
@@ -94,6 +97,10 @@ class QueryEngine:
         stats = ExecutionStats()
         results = []
         try:
+            # pipelined execution: dispatch every segment kernel (async),
+            # then drain — device compute for segment k overlaps planning/
+            # shipping of k+1 and the collect of earlier segments
+            pending = []
             for seg in segments:
                 deadline.check(f"query on {ctx.table}")
                 stats.num_segments_queried += 1
@@ -101,8 +108,12 @@ class QueryEngine:
                 if executor.prune_segment(ctx, seg):
                     stats.num_segments_pruned += 1
                     continue
-                with trace.span(f"segment:{seg.name}"):
-                    res, seg_stats = executor.execute_segment(ctx, seg, device=device)
+                with trace.span(f"launch:{seg.name}"):
+                    pending.append(executor.launch_segment(ctx, seg, device=device))
+            for st in pending:
+                deadline.check(f"query on {ctx.table}")
+                with trace.span("collect"):
+                    res, seg_stats = executor.collect_segment(st)
                 stats.num_segments_processed += 1
                 stats.num_docs_scanned += seg_stats.num_docs_scanned
                 stats.add_index_uses(seg_stats.filter_index_uses)

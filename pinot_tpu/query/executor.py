@@ -90,15 +90,23 @@ def prune_segment(ctx: QueryContext, segment: ImmutableSegment) -> bool:
 # ---------------------------------------------------------------------------
 # Execution
 # ---------------------------------------------------------------------------
-def execute_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
-    """Run one query on one segment; returns (SegmentResult, ExecutionStats)."""
+def launch_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
+    """Phase 1 of pipelined execution: plan, ship inputs, and DISPATCH the
+    segment kernel (jax dispatch is asynchronous — the call returns as soon
+    as the work is enqueued).  Returns an opaque pending state for
+    collect_segment.
+
+    This is the pipeline-parallelism axis (SURVEY.md §2.5): while segment
+    k's kernel runs on device, the host plans/ships segment k+1 and later
+    drains results — the streaming overlap the reference gets from mailbox
+    block streaming."""
     import jax
 
     from pinot_tpu.query.startree import try_startree
 
     star = try_startree(ctx, segment)
     if star is not None:
-        return star
+        return ("done", star)
 
     stats = ExecutionStats(
         num_segments_queried=1,
@@ -110,14 +118,25 @@ def execute_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
     stats.filter_index_uses = tuple(plan.index_uses)
     cols = segment.to_device(device=device, columns=plan.needed_columns)
     params = {k: jax.device_put(v, device) for k, v in plan.params.items()}
+    out = plan.fn(cols, params)  # async dispatch; device_get happens at collect
+    return ("pending", ctx, segment, plan, out, stats)
+
+
+def collect_segment(state):
+    """Phase 2: block on the kernel's outputs and finish host-side."""
+    import jax
+
+    if state[0] == "done":
+        return state[1]
+    _, ctx, segment, plan, out, stats = state
 
     if plan.kind == "aggregation":
-        partials = jax.device_get(plan.fn(cols, params))
+        partials = jax.device_get(out)
         partials = [fn.host_partial(p) for fn, p in zip(plan.aggs, partials)]
         return AggSegmentResult(partials=partials), stats
 
     if plan.kind == "groupby_dense":
-        presence, partials = jax.device_get(plan.fn(cols, params))
+        presence, partials = jax.device_get(out)
         dense = DenseGroupData(
             presence=presence,
             partials=partials,
@@ -129,14 +148,19 @@ def execute_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
         return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense), stats
 
     if plan.kind == "groupby_sparse":
-        uniq, partials = jax.device_get(plan.fn(cols, params))
+        uniq, partials = jax.device_get(out)
         res = sparse_tables_to_result(plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit)
         stats.num_groups = len(res.keys[0]) if res.keys else 0
         return res, stats
 
     # selection
-    tmask = np.asarray(jax.device_get(plan.fn(cols, params)))
+    tmask = np.asarray(jax.device_get(out))
     return _gather_selection(ctx, plan, segment, tmask), stats
+
+
+def execute_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
+    """Run one query on one segment; returns (SegmentResult, ExecutionStats)."""
+    return collect_segment(launch_segment(ctx, segment, device=device))
 
 
 def _key_space_id(plan) -> Tuple:

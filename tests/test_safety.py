@@ -93,7 +93,8 @@ class TestMetricsAndTrace:
         assert tr is not None and tr["name"] == "query"
         names = [c["name"] for c in tr["children"]]
         assert "reduce" in names
-        assert sum(1 for n in names if n.startswith("segment:")) == 3
+        assert sum(1 for n in names if n.startswith("launch:")) == 3
+        assert sum(1 for n in names if n == "collect") == 3
         assert all(c["ms"] >= 0 for c in tr["children"])
 
     def test_trace_off_by_default(self):
@@ -120,3 +121,20 @@ class TestExplain:
         eng = _engine()
         eng.query("EXPLAIN PLAN FOR SELECT COUNT(*) FROM t")
         assert METRICS.snapshot()["counters"].get("docsScanned", 0) == 0
+
+
+class TestEnvConfigLayering:
+    def test_env_option_applies_and_query_overrides(self, monkeypatch):
+        from pinot_tpu.spi.env import env_options
+
+        monkeypatch.setenv("PINOT_TPU_OPT_numGroupsLimit", "7")
+        monkeypatch.setenv("PINOT_TPU_OPT_enableNullHandling", "false")
+        opts = env_options()
+        assert opts["numGroupsLimit"] == 7 and opts["enableNullHandling"] is False
+        eng = _engine(n=500, segments=1)
+        # env default caps the group count...
+        res = eng.query("SELECT v, COUNT(*) FROM t GROUP BY v LIMIT 1000")
+        assert len(res.rows) <= 7
+        # ...but an explicit per-query SET wins over the env layer
+        res2 = eng.query("SET numGroupsLimit = 1000; SELECT v, COUNT(*) FROM t GROUP BY v LIMIT 1000")
+        assert len(res2.rows) > 7

@@ -23,6 +23,7 @@ Re-design, two executions domains:
 """
 from __future__ import annotations
 
+import math
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax.numpy as jnp
@@ -236,6 +237,52 @@ DEVICE_FNS: Dict[str, Callable] = {
     "tanh": jnp.tanh,
     "degrees": jnp.degrees,
     "radians": jnp.radians,
+}
+
+
+# ---------------------------------------------------------------------------
+# Geo functions (device): haversine distance + quantized grid cells.
+# Reference: Pinot's ST_DISTANCE + H3 index (BaseH3IndexCreator, h3 JNI).
+# Delta: no H3 library in-image — GEOGRID is a lat/lng quantization with the
+# same analytical role (cell bucketing for GROUP BY / coarse containment);
+# distances are exact haversine on the VPU, vectorized over all rows.
+# ---------------------------------------------------------------------------
+_EARTH_RADIUS_M = 6371008.8
+
+
+def st_distance(lat1, lng1, lat2, lng2):
+    """Great-circle distance in meters (haversine), any mix of traced
+    arrays and scalars."""
+    to_rad = math.pi / 180.0
+    p1 = _asf64(lat1) * to_rad
+    p2 = _asf64(lat2) * to_rad
+    dphi = (_asf64(lat2) - _asf64(lat1)) * to_rad
+    dlmb = (_asf64(lng2) - _asf64(lng1)) * to_rad
+    a = jnp.sin(dphi / 2) ** 2 + jnp.cos(p1) * jnp.cos(p2) * jnp.sin(dlmb / 2) ** 2
+    return 2.0 * _EARTH_RADIUS_M * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+def geogrid(lat, lng, precision):
+    """Quantized geo cell id: a 2^p x 2^p lat/lng grid (H3-cell analog for
+    bucketing; cell = row * 2^p + col, groupable via expr_int_range)."""
+    n = 1 << int(precision)
+    cx = jnp.clip(((_asf64(lng) + 180.0) / 360.0 * n).astype(jnp.int64), 0, n - 1)
+    cy = jnp.clip(((_asf64(lat) + 90.0) / 180.0 * n).astype(jnp.int64), 0, n - 1)
+    return cy * np.int64(n) + cx
+
+
+def _asf64(v):
+    return v.astype(jnp.float64) if hasattr(v, "astype") else jnp.float64(v)
+
+
+# multi-argument device functions: fn(*evaluated_args) — args arrive in SQL
+# order, literals as python scalars, columns/exprs as traced arrays
+DEVICE_MULTI_FNS: Dict[str, Callable] = {
+    "st_distance": st_distance,
+    "stdistance": st_distance,
+    "geogrid": geogrid,
+    "atan2": lambda y, x: jnp.arctan2(_asf64(y), _asf64(x)),
+    "power": lambda a, b: jnp.power(_asf64(a), _asf64(b)),
 }
 
 
@@ -476,6 +523,12 @@ def expr_int_range(expr, segment) -> Optional[Tuple[int, int]]:
         ml = getattr(c, "mv_lengths", None)
         if ml is not None and len(ml):
             return (0, int(ml.max()))
+        return None
+    if op == "geogrid":
+        lits2 = [a.value for a in expr.args if a.is_literal]
+        if lits2:
+            n = 1 << int(lits2[-1])
+            return (0, n * n - 1)
         return None
     if op in ("plus", "add", "minus", "sub", "times", "mult") and len(expr.args) == 2:
         ra = expr_int_range(expr.args[0], segment)

@@ -121,6 +121,17 @@ def eval_expr(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
             acc = jnp.minimum(acc, v) if op == "least" else jnp.maximum(acc, v)
             nl = _or_masks(nl, n)
         return acc, nl
+    if op in scalar.DEVICE_MULTI_FNS:
+        # positional: every arg evaluates (literals stay scalars)
+        vals, nulls = [], None
+        for a in expr.args:
+            if a.is_literal:
+                vals.append(a.value)
+            else:
+                v, nv = eval_expr(a, segment, cols)
+                vals.append(v)
+                nulls = _or_masks(nulls, nv)
+        return scalar.DEVICE_MULTI_FNS[op](*vals), nulls
     if op in scalar.DEVICE_FNS:
         # one traced operand + literal parameters, in SQL order
         # (DATETRUNC('day', ts) / ROUND(x, 2) / TIMECONVERT(t, 'SECONDS', 'DAYS'))
@@ -180,6 +191,12 @@ def eval_expr_host(expr: Expr, segment: ImmutableSegment, docids: np.ndarray) ->
         return a / b
     if op in _UNARY and len(expr.args) == 1:
         return np.asarray(_UNARY[op](jnp.asarray(eval_expr_host(expr.args[0], segment, docids))))
+    if op in scalar.DEVICE_MULTI_FNS:
+        vals = [
+            a.value if a.is_literal else jnp.asarray(eval_expr_host(a, segment, docids).astype(np.float64))
+            for a in expr.args
+        ]
+        return np.asarray(scalar.DEVICE_MULTI_FNS[op](*vals))
     if op in scalar.DEVICE_FNS:
         traced = [a for a in expr.args if not a.is_literal]
         lits = [a.value for a in expr.args if a.is_literal]

@@ -121,7 +121,26 @@ class Broker:
         if table not in self.coordinator.tables:
             raise KeyError(f"table {table!r} not found")
         self._inject_global_ranges(ctx, table)
-        seg_names, pruned = self._prune(ctx, table)
+        # hybrid tables (offline segments + a realtime manager under ONE
+        # name): a TIME BOUNDARY splits the parts — offline answers
+        # ts <= boundary, realtime answers ts > boundary (TimeBoundaryManager
+        # analog; late events below the boundary are excluded from the
+        # realtime part, matching the reference)
+        offline_ctx, realtime_ctx = ctx, ctx
+        meta = self.coordinator.tables[table]
+        rt = self.coordinator.realtime.get(table)
+        tc = meta.config.segments.time_column
+        if rt is not None and meta.ideal and tc:
+            ends = [
+                sm["timeRange"][1]
+                for sm in meta.segment_meta.values()
+                if isinstance(sm, dict) and sm.get("timeRange") is not None
+            ]
+            if ends:
+                boundary = max(ends)
+                offline_ctx = _with_time_bound(ctx, tc, upper=boundary)
+                realtime_ctx = _with_time_bound(ctx, tc, lower_exclusive=boundary)
+        seg_names, pruned = self._prune(offline_ctx, table)
         stats = ExecutionStats(num_segments_pruned=pruned)
         results = []
         if seg_names:
@@ -130,7 +149,7 @@ class Broker:
             for server_name, segs in assign.items():
                 deadline.check(f"query on {table}")
                 server = self.coordinator.servers[server_name]
-                res, sstats = server.execute(ctx, segs)
+                res, sstats = server.execute(offline_ctx, segs)
                 results.extend(res)
                 stats.num_segments_queried += sstats.num_segments_queried
                 stats.num_segments_processed += sstats.num_segments_processed
@@ -148,10 +167,10 @@ class Broker:
                 deadline.check(f"query on {table}")
                 stats.num_segments_queried += 1
                 stats.total_docs += seg.num_docs
-                if sse_executor.prune_segment(ctx, seg):
+                if sse_executor.prune_segment(realtime_ctx, seg):
                     stats.num_segments_pruned += 1
                     continue
-                res, sstats = sse_executor.execute_segment(ctx, seg)
+                res, sstats = sse_executor.execute_segment(realtime_ctx, seg)
                 stats.num_segments_processed += 1
                 stats.num_docs_scanned += sstats.num_docs_scanned
                 stats.add_index_uses(sstats.filter_index_uses)
@@ -189,6 +208,23 @@ class Broker:
             if fps:
                 only = next(iter(fps)) if len(fps) == 1 else None
                 ctx.options.setdefault(fkey, "MIXED" if len(fps) > 1 else (only or ""))
+
+
+def _with_time_bound(ctx: QueryContext, time_column: str, upper=None, lower_exclusive=None) -> QueryContext:
+    """ctx with an extra AND bound on the time column (hybrid-table split)."""
+    import dataclasses
+
+    from pinot_tpu.query.ir import Expr, Predicate
+
+    if upper is not None:
+        pred = Predicate(PredicateType.RANGE, Expr.col(time_column), upper=upper)
+    else:
+        pred = Predicate(
+            PredicateType.RANGE, Expr.col(time_column), lower=lower_exclusive, lower_inclusive=False
+        )
+    node = FilterNode.pred(pred)
+    f = node if ctx.filter is None else FilterNode.and_(ctx.filter, node)
+    return dataclasses.replace(ctx, filter=f)
 
 
 # ---------------------------------------------------------------------------

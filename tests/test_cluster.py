@@ -228,3 +228,50 @@ class TestRealtimeInCluster:
         total = broker.query("SELECT COUNT(*) FROM rt").rows[0][0]
         offline = broker.query(f"SELECT COUNT(*) FROM {report['offlineTable']}").rows[0][0]
         assert offline == 80 and total == 20  # consuming tail stays realtime
+
+
+class TestHybridTable:
+    def test_time_boundary_split(self, tmp_path):
+        """Offline + realtime parts under ONE name: offline serves
+        ts <= boundary, realtime serves ts > boundary — rows in both parts
+        are never double-counted."""
+        from pinot_tpu.realtime import InMemoryStream
+        from pinot_tpu.spi.config import StreamConfig
+
+        coord = Coordinator(replication=1)
+        coord.register_server(ServerInstance("s0"))
+        stream = InMemoryStream(1)
+        cfg = TableConfig(
+            name="h",
+            segments=SegmentsConfig(time_column="ts"),
+            stream=StreamConfig(stream_type="memory", max_rows_per_segment=1000),
+        )
+        schema = Schema(
+            "h",
+            [
+                FieldSpec("city", DataType.STRING),
+                FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+            ],
+        )
+        mgr = coord.add_realtime_table(schema, cfg, str(tmp_path / "h"), stream=stream)
+        t0 = 1_700_000_000_000
+        # offline segment holds days 0..9 (boundary becomes t0+9)
+        off = {
+            "city": np.array(["sf"] * 10, dtype=object),
+            "v": np.arange(10),
+            "ts": (t0 + np.arange(10)).astype(np.int64),
+        }
+        coord.add_segment("h", build_segment(schema, off, "off0", table_config=cfg))
+        # realtime got days 5..19 — rows 5..9 OVERLAP the offline segment
+        rows = [{"city": "sf", "v": int(i), "ts": t0 + i} for i in range(5, 20)]
+        stream.publish_many(rows, partition=0)
+        coord.run_realtime_consumption()
+        broker = Broker(coord)
+        res = broker.query("SELECT COUNT(*), SUM(v) FROM h")
+        # 0..9 from offline + 10..19 from realtime; overlap rows count once
+        assert res.rows[0][0] == 20
+        assert res.rows[0][1] == sum(range(20))
+        # user filters compose with the boundary
+        res2 = broker.query(f"SELECT COUNT(*) FROM h WHERE ts >= {t0 + 8}")
+        assert res2.rows[0][0] == 12  # 8..19

@@ -256,18 +256,22 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
     else:
         want = ctx.offset + ctx.limit
     if ctx.order_by:
-        for ob in ctx.order_by:
-            if not ob.expr.is_column:
-                raise NotImplementedError("selection ORDER BY supports bare columns only (for now)")
         if len(docids) > want:
             # Per-segment trim: WITHIN one segment dict codes are sort ranks
             # (sorted dictionary), so a numeric lexsort on codes/values is a
-            # correct local top-k regardless of type.  lexsort's primary key
-            # is the LAST array; push (value, null_rank) per order-by expr in
-            # reverse significance.
+            # correct local top-k regardless of type.  Expression keys
+            # evaluate host-side over the matched rows (O(matched)).
+            # lexsort's primary key is the LAST array; push
+            # (value, null_rank) per order-by expr in reverse significance.
             lex_keys: List[np.ndarray] = []
             for ob in reversed(ctx.order_by):
-                value_key, null_rank = _local_order_key(segment, ob.expr.op, docids, ob.ascending, ob.nulls_last)
+                if ob.expr.is_column:
+                    value_key, null_rank = _local_order_key(
+                        segment, ob.expr.op, docids, ob.ascending, ob.nulls_last
+                    )
+                else:
+                    value_key = _expr_order_key(segment, ob.expr, docids, ob.ascending)
+                    null_rank = None
                 lex_keys.append(value_key)
                 if null_rank is not None:
                     lex_keys.append(null_rank)
@@ -328,7 +332,7 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
     # Cross-segment merge needs real VALUES for order columns (codes are
     # segment-local); reduce.py re-sorts the concatenated trimmed rows.
     for i, ob in enumerate(ctx.order_by):
-        arrays[f"__ord{i}"] = _decoded(ob.expr.op)
+        arrays[f"__ord{i}"] = _value_array(ob.expr)
     cols = out_keys + [f"__ord{i}" for i in range(len(ctx.order_by))]
     cols += sorted(k for k in arrays if k.startswith("__wx_"))
     return SelectionSegmentResult(columns=cols, arrays=arrays)
@@ -358,6 +362,23 @@ def order_key_arrays(
         null_rank = np.where(nullm, np.int8(1 if nulls_last else -1), np.int8(0))
         key = np.where(nullm, key.dtype.type(0), key)
     return key, null_rank
+
+
+def _expr_order_key(segment: ImmutableSegment, expr, docids: np.ndarray, ascending: bool) -> np.ndarray:
+    """Lexsort key for an ORDER BY expression: host evaluation over matched
+    rows; numeric negate for DESC, string rank codes otherwise."""
+    vals = eval_expr_host(expr, segment, docids)
+    a = np.asarray(vals)
+    if a.dtype == object:
+        try:
+            a = a.astype(np.float64)
+        except (ValueError, TypeError):
+            pass
+    if np.issubdtype(a.dtype, np.number):
+        a = a.astype(np.float64)
+        return a if ascending else -a
+    _, inv = np.unique(a.astype(str), return_inverse=True)
+    return inv if ascending else -inv
 
 
 def _local_order_key(segment: ImmutableSegment, col: str, docids: np.ndarray, ascending: bool, nulls_last: bool):

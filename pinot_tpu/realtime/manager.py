@@ -74,7 +74,8 @@ class RealtimeSegmentDataManager:
                 if not self.table._should_index(self, msg):
                     self.offset = msg.offset
                     continue
-                doc_id = self.mutable.index(msg.value)
+                row = self.table._transform_row(self, msg)
+                doc_id = self.mutable.index(row)
                 self.table._on_indexed(self, msg, doc_id)
                 self.offset = msg.offset
                 ingested += 1
@@ -235,6 +236,13 @@ class RealtimeTableDataManager:
         if self.dedup is not None:
             return self.dedup.should_index(mgr, msg)
         return True
+
+    def _transform_row(self, mgr: RealtimeSegmentDataManager, msg) -> Dict[str, Any]:
+        """Record-transform hook: PARTIAL upsert merges the incoming row
+        with the current winning row before indexing."""
+        if self.upsert is not None:
+            return self.upsert.transform_row(self, mgr, msg)
+        return msg.value
 
     def _on_indexed(self, mgr: RealtimeSegmentDataManager, msg, doc_id: int) -> None:
         if self.upsert is not None:

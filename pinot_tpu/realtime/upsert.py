@@ -24,6 +24,10 @@ from pinot_tpu.spi.config import TableConfig
 from pinot_tpu.spi.schema import Schema
 
 
+def _np_item(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
 class _Location:
     __slots__ = ("segment", "doc", "cmp")
 
@@ -106,6 +110,57 @@ class PartitionUpsertMetadataManager:
 
     def on_rolled(self, mgr) -> None:
         self.track_consuming(mgr.mutable.name)
+
+    # -- PARTIAL upsert ---------------------------------------------------
+    def transform_row(self, table_mgr, mgr, msg) -> Dict[str, Any]:
+        """PARTIAL mode: merge the incoming row with the current winning row
+        per column strategy (PartialUpsertHandler analog).  Strategies:
+        OVERWRITE (default; incoming None keeps old), IGNORE (keep old),
+        INCREMENT (old + new).  APPEND/UNION need MV realtime (unsupported)."""
+        row = msg.value
+        if (self.config.upsert.mode or "").upper() != "PARTIAL":
+            return row
+        cur = self.pk_map.get(self._pk_of(row))
+        if cur is None:
+            return row
+        old = self._read_row(table_mgr, cur)
+        if old is None:
+            return row
+        merged: Dict[str, Any] = {}
+        strategies = {
+            k.lower(): v.upper() for k, v in self.config.upsert.partial_upsert_strategies.items()
+        }
+        for f in self.schema.fields:
+            name = f.name
+            strat = strategies.get(name.lower(), "OVERWRITE")
+            new_v, old_v = row.get(name), old.get(name)
+            if name in self.pk_cols or name == self.cmp_col:
+                merged[name] = new_v
+            elif strat == "IGNORE":
+                merged[name] = old_v
+            elif strat == "INCREMENT":
+                merged[name] = (old_v or 0) + (new_v or 0)
+            elif strat in ("APPEND", "UNION"):
+                raise NotImplementedError(
+                    f"partial-upsert strategy {strat} needs multi-value realtime columns"
+                )
+            else:  # OVERWRITE
+                merged[name] = new_v if new_v is not None else old_v
+        return merged
+
+    def _read_row(self, table_mgr, loc: _Location) -> Optional[Dict[str, Any]]:
+        """Point-read the winning row's values at its current location."""
+        for mgr in table_mgr.managers.values():
+            if mgr.mutable.name == loc.segment:
+                return {f.name: mgr.mutable.value_at(f.name, loc.doc) for f in self.schema.fields}
+        for segs in table_mgr.sealed.values():
+            for seg in segs:
+                if seg.name == loc.segment:
+                    return {
+                        f.name: _np_item(seg.column(f.name).decoded()[loc.doc])
+                        for f in self.schema.fields
+                    }
+        return None
 
     # -- query-time ------------------------------------------------------
     def attach_snapshot_mask(self, snapshot: ImmutableSegment, name: str) -> None:

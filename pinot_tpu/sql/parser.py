@@ -93,7 +93,9 @@ KEYWORDS = {
     "true", "false", "filter", "option",
     "join", "on", "inner", "left", "right", "full", "cross", "outer",
     "over", "partition", "union", "intersect", "except", "all",
-    "explain", "plan", "for",
+    # NOTE: explain/plan/for are intentionally NOT keywords — they are
+    # matched as words only in the EXPLAIN PLAN FOR prefix so columns named
+    # `plan` keep working
 }
 
 
@@ -195,11 +197,14 @@ class _Parser:
     # -- entry -----------------------------------------------------------
     def parse(self) -> QueryContext:
         options = {}
-        # EXPLAIN PLAN FOR SELECT ... (Pinot explain syntax)
-        if self.at_kw("explain"):
+        # EXPLAIN PLAN FOR SELECT ... (Pinot explain syntax); matched as
+        # words, not keywords, so `plan`/`for` stay valid identifiers
+        if self.cur.kind == "ident" and str(self.cur.value).lower() == "explain":
             self.advance()
-            self.expect_kw("plan")
-            self.expect_kw("for")
+            for w in ("plan", "for"):
+                if not (self.cur.kind in ("ident", "kw") and str(self.cur.value).lower() == w):
+                    self.fail(f"expected {w.upper()} after EXPLAIN")
+                self.advance()
             options["__explain__"] = True
         # Pinot option prelude: SET key = value; ... SELECT ...
         while self.at_kw("set"):

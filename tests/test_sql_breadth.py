@@ -169,3 +169,14 @@ class TestReviewRegressions:
         assert res.columns == ["Operator", "Operator_Id", "Parent_Id"]
         ids = [r[1] for r in res.rows]
         assert len(ids) == len(set(ids))  # one coherent plan, not a union of two
+
+    def test_selection_order_by_expression(self, env):
+        """ORDER BY <expr> on selection queries (round-2 weak #5 cliff)."""
+        eng, conn = env
+        sql = "SELECT city, v, score FROM t WHERE v > 9900 ORDER BY v * 2 + score DESC LIMIT 30"
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+    def test_selection_order_by_string_function(self, env):
+        eng, conn = env
+        sql = "SELECT dept, v FROM t WHERE v > 9950 ORDER BY UPPER(dept), v LIMIT 40"
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)

@@ -187,3 +187,91 @@ class TestDedup:
         mgr2 = RealtimeTableDataManager(_schema(), cfg, data_dir, stream=stream)
         mgr2.consume_all()
         assert mgr2.total_rows == 8
+
+
+class TestPartialUpsert:
+    def test_partial_strategies(self, tmp_path):
+        """PARTIAL mode: INCREMENT accumulates, IGNORE keeps first,
+        OVERWRITE replaces (None keeps old) — PartialUpsertHandler analog."""
+        from pinot_tpu.spi.config import SegmentsConfig, StreamConfig
+
+        schema = Schema(
+            name="acct",
+            fields=[
+                FieldSpec("k", DataType.STRING),
+                FieldSpec("plan", DataType.STRING),
+                FieldSpec("clicks", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+            ],
+            primary_key_columns=["k"],
+        )
+        cfg = TableConfig(
+            name="acct",
+            segments=SegmentsConfig(time_column="ts"),
+            stream=StreamConfig(stream_type="memory", max_rows_per_segment=4),
+            upsert=UpsertConfig(
+                mode="PARTIAL",
+                comparison_column="ts",
+                partial_upsert_strategies={"clicks": "INCREMENT", "plan": "IGNORE"},
+            ),
+        )
+        from pinot_tpu.realtime import InMemoryStream, RealtimeTableDataManager
+
+        stream = InMemoryStream(1)
+        mgr = RealtimeTableDataManager(schema, cfg, str(tmp_path / "acct"), stream=stream)
+        eng = QueryEngine()
+        eng.register_table(schema, cfg)
+        eng.attach_realtime("acct", mgr)
+        events = [
+            {"k": "a", "plan": "free", "clicks": 1, "ts": 1},
+            {"k": "b", "plan": "pro", "clicks": 10, "ts": 2},
+            {"k": "a", "plan": "ent", "clicks": 2, "ts": 3},   # plan IGNOREd, clicks += 2
+            {"k": "a", "plan": None, "clicks": 4, "ts": 4},    # clicks += 4
+            {"k": "b", "plan": "ent", "clicks": 5, "ts": 5},   # clicks += 5
+        ]
+        stream.publish_many(events, partition=0)
+        mgr.consume_all()
+        res = eng.query("SELECT COUNT(*), SUM(clicks) FROM acct")
+        assert res.rows[0][0] == 2           # one live row per key
+        assert res.rows[0][1] == 7 + 15      # a: 1+2+4, b: 10+5
+        plans = eng.query("SELECT plan, COUNT(*) FROM acct GROUP BY plan ORDER BY plan")
+        assert {r[0] for r in plans.rows} == {"free", "pro"}  # IGNORE kept firsts
+
+    def test_partial_merge_across_seal(self, tmp_path):
+        """The merge reads the winning row even after it sealed."""
+        from pinot_tpu.spi.config import SegmentsConfig, StreamConfig
+
+        schema = Schema(
+            name="acct",
+            fields=[
+                FieldSpec("k", DataType.STRING),
+                FieldSpec("clicks", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+            ],
+            primary_key_columns=["k"],
+        )
+        cfg = TableConfig(
+            name="acct",
+            segments=SegmentsConfig(time_column="ts"),
+            stream=StreamConfig(stream_type="memory", max_rows_per_segment=2),
+            upsert=UpsertConfig(
+                mode="PARTIAL", comparison_column="ts",
+                partial_upsert_strategies={"clicks": "INCREMENT"},
+            ),
+        )
+        from pinot_tpu.realtime import InMemoryStream, RealtimeTableDataManager
+
+        stream = InMemoryStream(1)
+        mgr = RealtimeTableDataManager(schema, cfg, str(tmp_path / "acct"), stream=stream)
+        eng = QueryEngine()
+        eng.register_table(schema, cfg)
+        eng.attach_realtime("acct", mgr)
+        stream.publish_many(
+            [{"k": "a", "clicks": 3, "ts": 1}, {"k": "b", "clicks": 1, "ts": 2}], partition=0
+        )
+        mgr.consume_all()
+        assert len(mgr.sealed[0]) == 1  # both rows sealed
+        stream.publish({"k": "a", "clicks": 10, "ts": 3}, partition=0)
+        mgr.consume_all()
+        res = eng.query("SELECT SUM(clicks) FROM acct")
+        assert res.rows[0][1 - 1] == 13 + 1  # a merged 3+10 across the seal, b intact

@@ -114,6 +114,10 @@ def eval_expr(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
         if "lengths" not in entry:
             raise ValueError(f"{op} requires a multi-value column ({expr.args[0].op} is single-value)")
         return entry["lengths"].astype(jnp.int32), None
+    if op == "case":
+        return _eval_case(expr, segment, cols)
+    if op in ("__and", "__or", "__not", "__eq", "__in", "__ge", "__gt", "__le", "__lt", "__isnull"):
+        return _eval_bool(expr, segment, cols), None
     if op in ("least", "greatest") and expr.args:
         vals, nulls = zip(*(eval_expr(a, segment, cols) for a in expr.args))
         acc, nl = vals[0], nulls[0]
@@ -160,6 +164,122 @@ def eval_expr(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
     raise ValueError(f"unsupported transform function {op!r} in {expr}")
 
 
+def _eval_bool_host(expr: Expr, segment: ImmutableSegment, docids: np.ndarray) -> np.ndarray:
+    """Host (numpy) twin of _eval_bool for selection-path CASE."""
+    op = expr.op
+    if op == "__and":
+        out = None
+        for a in expr.args:
+            b = _eval_bool_host(a, segment, docids)
+            out = b if out is None else out & b
+        return out
+    if op == "__or":
+        out = None
+        for a in expr.args:
+            b = _eval_bool_host(a, segment, docids)
+            out = b if out is None else out | b
+        return out
+    if op == "__not":
+        return ~_eval_bool_host(expr.args[0], segment, docids)
+    lhs = expr.args[0]
+    lits = [a.value for a in expr.args[1:]]
+    if op == "__isnull":
+        if lhs.is_column and segment.column(lhs.op).nulls is not None:
+            return segment.column(lhs.op).nulls[docids]
+        return np.zeros(len(docids), dtype=bool)
+    v = eval_expr_host(lhs, segment, docids)
+    if op == "__eq":
+        return np.asarray([x == lits[0] for x in v], dtype=bool)
+    if op == "__in":
+        s = set(lits)
+        return np.asarray([x in s for x in v], dtype=bool)
+    v = np.asarray(v, dtype=np.float64)
+    if op == "__ge":
+        return v >= lits[0]
+    if op == "__gt":
+        return v > lits[0]
+    if op == "__le":
+        return v <= lits[0]
+    return v < lits[0]
+
+
+def _eval_bool(expr: Expr, segment: ImmutableSegment, cols: Dict):
+    """CASE condition ops -> traced bool row mask (CaseTransformFunction's
+    WHEN evaluation).  String equality/IN resolve against the dictionary
+    (code compares); numerics compare values directly."""
+    op = expr.op
+    if op == "__and":
+        out = None
+        for a in expr.args:
+            b = _eval_bool(a, segment, cols)
+            out = b if out is None else out & b
+        return out
+    if op == "__or":
+        out = None
+        for a in expr.args:
+            b = _eval_bool(a, segment, cols)
+            out = b if out is None else out | b
+        return out
+    if op == "__not":
+        return ~_eval_bool(expr.args[0], segment, cols)
+    lhs = expr.args[0]
+    lits = [a.value for a in expr.args[1:]]
+    if op == "__isnull":
+        entry = cols.get(lhs.op, {}) if lhs.is_column else {}
+        if "nulls" in entry:
+            return entry["nulls"]
+        n = segment.num_docs
+        return jnp.zeros((n,), dtype=bool)
+    # string column comparisons resolve to dictionary codes
+    if lhs.is_column and segment.column(lhs.op).data_type.is_string_like:
+        c = segment.column(lhs.op)
+        codes = cols[lhs.op]["codes"].astype(jnp.int32)
+        ids = [c.dictionary.index_of(v) for v in lits]
+        if op == "__eq":
+            return codes == np.int32(ids[0])
+        if op == "__in":
+            valid = np.asarray([i for i in ids if i >= 0], dtype=np.int32)
+            return jnp.isin(codes, valid) if len(valid) else jnp.zeros(codes.shape, bool)
+        raise ValueError(f"CASE condition {op} not supported on string column {lhs.op}")
+    v, _ = eval_expr(lhs, segment, cols)
+    if op == "__eq":
+        return v == lits[0]
+    if op == "__in":
+        return jnp.isin(v, jnp.asarray(lits))
+    if op == "__ge":
+        return v >= lits[0]
+    if op == "__gt":
+        return v > lits[0]
+    if op == "__le":
+        return v <= lits[0]
+    return v < lits[0]
+
+
+def _eval_case(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
+    """CASE WHEN ... THEN ... ELSE ... END: reverse-fold of jnp.where.
+    An omitted ELSE yields SQL NULL via the null mask."""
+    args = list(expr.args)
+    else_e = args[-1]
+    pairs = list(zip(args[:-1:2], args[1::2]))
+    if else_e.is_literal and else_e.value is None:
+        out, nulls = jnp.float64(0.0), None
+        else_null = True
+    else:
+        out, nulls = eval_expr(else_e, segment, cols)
+        else_null = False
+    any_cond = None
+    for cond_e, then_e in reversed(pairs):
+        cond = _eval_bool(cond_e, segment, cols)
+        tv, tn = eval_expr(then_e, segment, cols)
+        out = jnp.where(cond, tv, out)
+        nulls = _or_masks(nulls, tn)
+        any_cond = cond if any_cond is None else (any_cond | cond)
+    if else_null:
+        no_match = ~any_cond
+        nulls = no_match if nulls is None else (nulls | no_match)
+    return out, nulls
+
+
 def eval_expr_host(expr: Expr, segment: ImmutableSegment, docids: np.ndarray) -> np.ndarray:
     """Host-side expression evaluation over a SELECTED row subset (selection
     queries gather at most offset+limit rows, so O(rows-out) host work).
@@ -174,6 +294,19 @@ def eval_expr_host(expr: Expr, segment: ImmutableSegment, docids: np.ndarray) ->
         if c.mv_lengths is None:
             raise ValueError(f"{expr.op} requires a multi-value column")
         return c.mv_lengths[docids].astype(np.int64)
+    if expr.op == "case":
+        args = list(expr.args)
+        else_e = args[-1]
+        pairs = list(zip(args[:-1:2], args[1::2]))
+        if else_e.is_literal and else_e.value is None:
+            out = np.full(len(docids), None, dtype=object)
+        else:
+            out = np.asarray(eval_expr_host(else_e, segment, docids), dtype=object)
+        for cond_e, then_e in reversed(pairs):
+            cond = _eval_bool_host(cond_e, segment, docids)
+            tv = np.asarray(eval_expr_host(then_e, segment, docids), dtype=object)
+            out = np.where(cond, tv, out)
+        return out
     if scalar.is_dict_fn_expr(expr):
         col = next(a for a in expr.args if not a.is_literal).op
         c = segment.column(col)

@@ -70,6 +70,40 @@ class SqlParseError(ValueError):
     pass
 
 
+def _filter_to_expr(node: FilterNode) -> Expr:
+    """CASE condition -> boolean expression ops (__and/__or/__not/__eq/...)
+    the transform layer evaluates on device."""
+    if node.op is FilterOp.AND:
+        return Expr.call("__and", *[_filter_to_expr(c) for c in node.children])
+    if node.op is FilterOp.OR:
+        return Expr.call("__or", *[_filter_to_expr(c) for c in node.children])
+    if node.op is FilterOp.NOT:
+        return Expr.call("__not", _filter_to_expr(node.children[0]))
+    p = node.predicate
+    if p.ptype is PredicateType.EQ:
+        return Expr.call("__eq", p.lhs, Expr.lit(p.values[0]))
+    if p.ptype is PredicateType.NEQ:
+        return Expr.call("__not", Expr.call("__eq", p.lhs, Expr.lit(p.values[0])))
+    if p.ptype is PredicateType.IN:
+        return Expr.call("__in", p.lhs, *[Expr.lit(v) for v in p.values])
+    if p.ptype is PredicateType.NOT_IN:
+        return Expr.call("__not", Expr.call("__in", p.lhs, *[Expr.lit(v) for v in p.values]))
+    if p.ptype is PredicateType.RANGE:
+        parts = []
+        if p.lower is not None:
+            parts.append(Expr.call("__ge" if p.lower_inclusive else "__gt", p.lhs, Expr.lit(p.lower)))
+        if p.upper is not None:
+            parts.append(Expr.call("__le" if p.upper_inclusive else "__lt", p.lhs, Expr.lit(p.upper)))
+        if len(parts) == 1:
+            return parts[0]
+        return Expr.call("__and", *parts)
+    if p.ptype is PredicateType.IS_NULL:
+        return Expr.call("__isnull", p.lhs)
+    if p.ptype is PredicateType.IS_NOT_NULL:
+        return Expr.call("__not", Expr.call("__isnull", p.lhs))
+    raise SqlParseError(f"unsupported predicate {p.ptype.value} inside a CASE condition")
+
+
 # ---------------------------------------------------------------------------
 # Lexer
 # ---------------------------------------------------------------------------
@@ -554,6 +588,38 @@ class _Parser:
         extra = tuple(a for a in args[1:] if not a.is_literal)
         return AggregationSpec(e.op, expr, literal_args=lits, extra_exprs=extra)
 
+    def _case_expr(self) -> Expr:
+        """CASE WHEN cond THEN expr ... [ELSE expr] END -> a `case` CALL
+        whose args alternate (condition-as-expr, result): conditions convert
+        through _filter_to_expr into boolean expression ops the transform
+        layer evaluates on device (CaseTransformFunction analog)."""
+        self.advance()  # CASE
+        def word(w):
+            t = self.cur
+            if t.kind in ("ident", "kw") and str(t.value).lower() == w:
+                self.advance()
+                return True
+            return False
+
+        args: List[Expr] = []
+        saw_when = False
+        while word("when"):
+            saw_when = True
+            cond = self.boolean_expr()
+            args.append(_filter_to_expr(cond))
+            if not word("then"):
+                self.fail("expected THEN in CASE")
+            args.append(self.expr())
+        if not saw_when:
+            self.fail("expected WHEN in CASE")
+        if word("else"):
+            args.append(self.expr())
+        else:
+            args.append(Expr.lit(None))
+        if not word("end"):
+            self.fail("expected END closing CASE")
+        return Expr.call("case", *args)
+
     # -- boolean (filter) grammar ---------------------------------------
     def boolean_expr(self) -> FilterNode:
         node = self.boolean_term()
@@ -737,6 +803,8 @@ class _Parser:
             return e
         if self.accept_op("*"):
             return Expr.col("*")
+        if t.kind == "ident" and str(t.value).lower() == "case":
+            return self._case_expr()
         if t.kind == "ident" or (t.kind == "kw" and t.value in ("filter",)):
             name = self.advance().value
             if self.accept_op("("):

@@ -211,3 +211,36 @@ class TestFunctionRegistry:
         assert "datetrunc" in fns["device"]
         assert "upper" in fns["dictionary"]
         assert "percentilekll" in fns["aggregation"]
+
+
+class TestCaseWhen:
+    """CASE WHEN ... THEN ... [ELSE ...] END (CaseTransformFunction)."""
+
+    def test_case_in_aggregation(self, eng, conn):
+        sql = (
+            "SELECT SUM(CASE WHEN v > 500 THEN v ELSE 0 END), "
+            "SUM(CASE WHEN city = 'sf' THEN 1 ELSE 0 END) FROM ev"
+        )
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall())
+
+    def test_case_with_in_and_and(self, eng, conn):
+        sql = (
+            "SELECT SUM(CASE WHEN city IN ('sf', 'NY') AND v >= 100 THEN price ELSE 0 END) FROM ev"
+        )
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall())
+
+    def test_case_in_selection(self, eng, conn):
+        sql = (
+            "SELECT v, CASE WHEN v > 990 THEN 1 WHEN v > 980 THEN 2 ELSE 3 END FROM ev "
+            "WHERE v > 970 ORDER BY v LIMIT 50"
+        )
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+    def test_case_null_else(self, eng, conn):
+        sql = "SELECT AVG(CASE WHEN city = 'sf' THEN v END) FROM ev"
+        # sqlite: AVG ignores NULLs from the implicit ELSE NULL — same here
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall())
+
+    def test_case_in_filter(self, eng, conn):
+        sql = "SELECT COUNT(*) FROM ev WHERE CASE WHEN city = 'sf' THEN v ELSE 0 END > 500"
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall())

@@ -65,6 +65,14 @@ def range_join(
     lo = jnp.searchsorted(sorted_keys, probe_keys)  # first slot of the run
     b = sorted_keys.shape[0]
     offs = jnp.arange(max_dup, dtype=lo.dtype)
-    cand = jnp.clip(lo[:, None] + offs[None, :], 0, b - 1)
-    match = (sorted_keys[cand] == probe_keys[:, None]) & (probe_keys[:, None] != KEY_SENTINEL)
+    pos = lo[:, None] + offs[None, :]
+    cand = jnp.clip(pos, 0, b - 1)
+    # pos >= b guards the end clip: without it, a run ending exactly at the
+    # array tail re-matches its last row through the clamped index
+    # (review-caught double count)
+    match = (
+        (sorted_keys[cand] == probe_keys[:, None])
+        & (probe_keys[:, None] != KEY_SENTINEL)
+        & (pos < b)
+    )
     return order[cand], match

@@ -270,8 +270,9 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
                         segment, ob.expr.op, docids, ob.ascending, ob.nulls_last
                     )
                 else:
-                    value_key = _expr_order_key(segment, ob.expr, docids, ob.ascending)
-                    null_rank = None
+                    value_key, null_rank = _expr_order_key(
+                        segment, ob.expr, docids, ob.ascending, ob.nulls_last
+                    )
                 lex_keys.append(value_key)
                 if null_rank is not None:
                     lex_keys.append(null_rank)
@@ -364,21 +365,42 @@ def order_key_arrays(
     return key, null_rank
 
 
-def _expr_order_key(segment: ImmutableSegment, expr, docids: np.ndarray, ascending: bool) -> np.ndarray:
-    """Lexsort key for an ORDER BY expression: host evaluation over matched
-    rows; numeric negate for DESC, string rank codes otherwise."""
+def _expr_order_key(
+    segment: ImmutableSegment, expr, docids: np.ndarray, ascending: bool, nulls_last: bool
+):
+    """(lexsort key, null_rank) for an ORDER BY expression: host evaluation
+    over matched rows; a row is NULL when any input column is null there
+    (SQL null propagation), ranked by NULLS FIRST/LAST — not by whatever
+    placeholder value the expression computed (review-caught)."""
     vals = eval_expr_host(expr, segment, docids)
+    nullm = None
+    for cname in expr.columns():
+        cn = segment.column(cname).nulls
+        if cn is not None:
+            m = cn[docids]
+            nullm = m if nullm is None else (nullm | m)
     a = np.asarray(vals)
     if a.dtype == object:
+        none_m = np.array([v is None for v in a], dtype=bool)
+        if none_m.any():
+            nullm = none_m if nullm is None else (nullm | none_m)
+            a = a.copy()
+            a[none_m] = 0
         try:
             a = a.astype(np.float64)
         except (ValueError, TypeError):
             pass
     if np.issubdtype(a.dtype, np.number):
-        a = a.astype(np.float64)
-        return a if ascending else -a
-    _, inv = np.unique(a.astype(str), return_inverse=True)
-    return inv if ascending else -inv
+        key = a.astype(np.float64)
+        key = key if ascending else -key
+    else:
+        _, inv = np.unique(a.astype(str), return_inverse=True)
+        key = inv if ascending else -inv
+    null_rank = None
+    if nullm is not None and nullm.any():
+        null_rank = np.where(nullm, np.int8(1 if nulls_last else -1), np.int8(0))
+        key = np.where(nullm, 0, key)
+    return key, null_rank
 
 
 def _local_order_key(segment: ImmutableSegment, col: str, docids: np.ndarray, ascending: bool, nulls_last: bool):

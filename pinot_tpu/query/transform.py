@@ -260,23 +260,26 @@ def _eval_case(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
     An omitted ELSE yields SQL NULL via the null mask."""
     args = list(expr.args)
     else_e = args[-1]
-    pairs = list(zip(args[:-1:2], args[1::2]))
-    if else_e.is_literal and else_e.value is None:
-        out, nulls = jnp.float64(0.0), None
-        else_null = True
-    else:
-        out, nulls = eval_expr(else_e, segment, cols)
-        else_null = False
-    any_cond = None
-    for cond_e, then_e in reversed(pairs):
-        cond = _eval_bool(cond_e, segment, cols)
-        tv, tn = eval_expr(then_e, segment, cols)
-        out = jnp.where(cond, tv, out)
-        nulls = _or_masks(nulls, tn)
-        any_cond = cond if any_cond is None else (any_cond | cond)
+    else_null = else_e.is_literal and else_e.value is None
     if else_null:
-        no_match = ~any_cond
-        nulls = no_match if nulls is None else (nulls | no_match)
+        out, en = jnp.float64(0.0), None  # implicit ELSE NULL
+    else:
+        out, en = eval_expr(else_e, segment, cols)
+    evaluated = [
+        (_eval_bool(c, segment, cols), *eval_expr(t, segment, cols))
+        for c, t in zip(args[:-1:2], args[1::2])
+    ]
+    # reverse-fold values AND null masks together: a row's nullness is the
+    # CHOSEN branch's nullness, not the OR of all branches (review-caught)
+    if else_null or en is not None or any(tn is not None for _, _, tn in evaluated):
+        nulls = en if en is not None else jnp.full((segment.num_docs,), else_null, dtype=bool)
+    else:
+        nulls = None
+    for cond, tv, tn in reversed(evaluated):
+        out = jnp.where(cond, tv, out)
+        if nulls is not None:
+            branch_null = tn if tn is not None else False
+            nulls = jnp.where(cond, branch_null, nulls)
     return out, nulls
 
 

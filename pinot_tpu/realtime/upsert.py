@@ -24,10 +24,6 @@ from pinot_tpu.spi.config import TableConfig
 from pinot_tpu.spi.schema import Schema
 
 
-def _np_item(v):
-    return v.item() if isinstance(v, np.generic) else v
-
-
 class _Location:
     __slots__ = ("segment", "doc", "cmp")
 
@@ -56,6 +52,10 @@ class PartitionUpsertMetadataManager:
         # pk tuple -> winning location; valid masks by segment name.
         self.pk_map: Dict[Tuple, _Location] = {}
         self.valid: Dict[str, Any] = {}  # list[bool] (consuming) | np.ndarray (sealed)
+        self._strategies = {
+            k.lower(): v.upper()
+            for k, v in (config.upsert.partial_upsert_strategies if config.upsert else {}).items()
+        }
 
     # -- helpers ---------------------------------------------------------
     def _pk_of(self, row: Dict[str, Any]) -> Tuple:
@@ -127,9 +127,7 @@ class PartitionUpsertMetadataManager:
         if old is None:
             return row
         merged: Dict[str, Any] = {}
-        strategies = {
-            k.lower(): v.upper() for k, v in self.config.upsert.partial_upsert_strategies.items()
-        }
+        strategies = self._strategies
         for f in self.schema.fields:
             name = f.name
             strat = strategies.get(name.lower(), "OVERWRITE")
@@ -156,10 +154,8 @@ class PartitionUpsertMetadataManager:
         for segs in table_mgr.sealed.values():
             for seg in segs:
                 if seg.name == loc.segment:
-                    return {
-                        f.name: _np_item(seg.column(f.name).decoded()[loc.doc])
-                        for f in self.schema.fields
-                    }
+                    # point reads, NOT full-column decodes (O(1) per field)
+                    return {f.name: seg.column(f.name).value_at(loc.doc) for f in self.schema.fields}
         return None
 
     # -- query-time ------------------------------------------------------

@@ -56,6 +56,22 @@ class ColumnData:
     def cardinality(self) -> int:
         return self.dictionary.cardinality if self.dictionary else self.stats.cardinality
 
+    def value_at(self, doc: int):
+        """Point read of one value (upsert merge reads) — O(1), no full
+        column materialization."""
+        if self.mv_lengths is not None:
+            ln = int(self.mv_lengths[doc])
+            if self.dictionary is not None:
+                return tuple(self.dictionary.get_values(self.codes[doc, :ln]))
+            return tuple(self.values[doc, :ln].tolist())
+        if self.nulls is not None and self.nulls[doc]:
+            return None
+        if self.dictionary is not None:
+            v = self.dictionary.get_values(np.asarray([self.codes[doc]]))[0]
+        else:
+            v = self.values[doc]
+        return v.item() if isinstance(v, np.generic) else v
+
     def decoded(self) -> np.ndarray:
         """Materialize raw values host-side (tests/golden comparisons).
         MV columns decode to an object array of tuples."""

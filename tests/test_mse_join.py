@@ -444,3 +444,19 @@ class TestManyToManyJoin:
                 "SET joinStrategy = 'shuffle'; "
                 "SELECT COUNT(*) FROM orders JOIN shipments ON o_key = s_key LIMIT 5"
             )
+
+    def test_range_join_end_clip_no_double_match(self):
+        """A run ending exactly at the build array tail must not re-match
+        its last row through the end clip (review-caught)."""
+        import jax.numpy as jnp
+
+        from pinot_tpu.mse.join import range_join
+
+        rows, match = range_join(
+            jnp.asarray([1, 2, 2, 3], dtype=jnp.int64),
+            jnp.ones(4, dtype=bool),
+            jnp.asarray([3], dtype=jnp.int64),
+            max_dup=2,
+        )
+        assert bool(match[0, 0]) is True
+        assert bool(match[0, 1]) is False  # key 3 appears once, not twice

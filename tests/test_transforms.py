@@ -244,3 +244,22 @@ class TestCaseWhen:
     def test_case_in_filter(self, eng, conn):
         sql = "SELECT COUNT(*) FROM ev WHERE CASE WHEN city = 'sf' THEN v ELSE 0 END > 500"
         assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall())
+
+    def test_case_chosen_branch_nullness(self):
+        """A row taking a non-null branch is NOT null even when another
+        branch's input is null there (review-caught)."""
+        schema = Schema(
+            "cn",
+            [
+                FieldSpec("x", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("nv", DataType.LONG, role=FieldRole.METRIC, nullable=True),
+            ],
+        )
+        data = {"x": np.array([1, -1, 1, -1]), "nv": np.array([None, None, 5, 7], dtype=object)}
+        e = QueryEngine()
+        e.register_table(schema)
+        e.add_segment("cn", build_segment(schema, data, "s0"))
+        # rows 0: x>0 -> nv NULL; 1: else 0; 2: x>0 -> 5; 3: else 0
+        res = e.query("SELECT SUM(CASE WHEN x > 0 THEN nv ELSE 0 END), COUNT(CASE WHEN x > 0 THEN nv ELSE 0 END) FROM cn")
+        assert res.rows[0][0] == 5    # NULL row skipped, ELSE-0 rows counted as 0
+        assert res.rows[0][1] == 3    # one row (row 0) is genuinely NULL

@@ -269,6 +269,8 @@ class DistributedEngine:
             num_groups = 0
 
         planner_mod.guard_sparse_vector_fields(kind, aggs)
+        if any(gd.mv for gd in group_dims):
+            raise NotImplementedError("MV GROUP BY (explode) is not yet supported on the distributed stacked path")
         if any(fn.pairwise_merge for fn in aggs):
             raise NotImplementedError(
                 "pairwise-merge aggregations (FIRST/LAST_WITH_TIME, DISTINCTCOUNTTHETA) "

@@ -71,6 +71,9 @@ class GroupDim:
     null_code: int = -1  # code representing SQL NULL (placeholder), -1 if none
     derived_values: Optional[np.ndarray] = None  # kind=derived decode table
     remap: Optional[np.ndarray] = None  # kind=derived code remap (int32)
+    # multi-value dimension: rows EXPLODE — each element contributes a row
+    # (Pinot's MV group-by semantics); kernels expand [n] -> [n, max_len]
+    mv: bool = False
 
     def decode(self, codes: np.ndarray) -> np.ndarray:
         if self.kind == "dict":
@@ -348,8 +351,10 @@ def _group_dim(expr: Expr, segment: ImmutableSegment, null_handling: bool) -> Gr
     if expr.is_column:
         c = segment.column(expr.op)
         if getattr(c, "is_multi_value", False):
-            raise NotImplementedError(
-                f"GROUP BY on multi-value column {c.name} (explode semantics) is not yet supported"
+            if c.dictionary is None:
+                raise NotImplementedError(f"GROUP BY on raw MV column {c.name} (vector columns are not groupable)")
+            return GroupDim(
+                expr, c.name, "dict", c.dictionary.cardinality, dictionary=c.dictionary, mv=True
             )
         null_code = -1
         if c.has_dictionary:
@@ -814,6 +819,50 @@ def _build_plan(
             tmask, _ = filter_fn(cols, params)
             return [fn.partial(vals, mask) for fn, (vals, mask) in zip(aggs, _agg_inputs(cols, params, tmask))]
 
+    mv_dims = [i for i, gd in enumerate(group_dims) if gd.mv]
+    if len(mv_dims) > 1:
+        raise NotImplementedError("at most one multi-value GROUP BY dimension (explode) per query")
+    if mv_dims and any(getattr(fn_, "mv_input", False) for fn_ in aggs):
+        raise NotImplementedError("MV aggregations cannot combine with an MV GROUP BY dimension")
+    mv_i = mv_dims[0] if mv_dims else None
+
+    def _mv_explode(cols, params, tmask, key_dtype):
+        """MV group-by explode: [n] -> flattened [n*max_len] key/mask/inputs
+        (each element of the MV dimension contributes one logical row —
+        Pinot's MV group-by semantics)."""
+        gd_mv = group_dims[mv_i]
+        entry = cols[gd_mv.name]
+        codes2 = entry["codes"].astype(jnp.int32)
+        pad = jnp.arange(codes2.shape[1], dtype=jnp.int32)[None, :] < entry["lengths"][:, None].astype(jnp.int32)
+        t2 = tmask[:, None] & pad
+        shape2 = t2.shape
+        key = None
+        for i2, gd in enumerate(group_dims):
+            if i2 == mv_i:
+                code = jnp.minimum(codes2, np.asarray(gd.cardinality - 1, dtype=key_dtype)).astype(key_dtype)
+            else:
+                code = jnp.broadcast_to(
+                    gd.device_code(cols, segment, key_dtype)[:, None], shape2
+                )
+            key = code if key is None else key * np.asarray(gd.cardinality, dtype=key_dtype) + code
+        inputs = _agg_inputs(cols, params, tmask)
+        flat_inputs = [
+            (
+                jnp.broadcast_to(jnp.broadcast_to(v, tmask.shape)[:, None], shape2).reshape(-1),
+                (m[:, None] & t2).reshape(-1),
+            )
+            for v, m in inputs
+        ]
+        return key.reshape(-1), t2.reshape(-1), flat_inputs
+
+    if kind == "groupby_dense" and mv_i is not None:
+        vranges = agg_vranges(agg_specs, segment)
+
+        def kernel(cols, params):
+            tmask, _ = filter_fn(cols, params)
+            key, t_f, inputs = _mv_explode(cols, params, tmask, jnp.int32)
+            return grouped_partials(aggs, inputs, t_f, key, num_groups, vranges)
+
     elif kind == "groupby_dense":
         vranges = agg_vranges(agg_specs, segment)
 
@@ -830,13 +879,22 @@ def _build_plan(
             raise NotImplementedError("composite group key exceeds 62 bits")
         num_slots = min(ctx.num_groups_limit, num_groups)
 
-        def kernel(cols, params):
-            tmask, _ = filter_fn(cols, params)
-            key = packed_key64(cols, group_dims, segment)
-            inputs = _agg_inputs(cols, params, tmask)
-            return sparse_grouped_tables(aggs, inputs, tmask, key, num_slots)
+        if mv_i is not None:
 
-    else:  # selection
+            def kernel(cols, params):
+                tmask, _ = filter_fn(cols, params)
+                key, t_f, inputs = _mv_explode(cols, params, tmask, jnp.int64)
+                return sparse_grouped_tables(aggs, inputs, t_f, key, num_slots)
+
+        else:
+
+            def kernel(cols, params):
+                tmask, _ = filter_fn(cols, params)
+                key = packed_key64(cols, group_dims, segment)
+                inputs = _agg_inputs(cols, params, tmask)
+                return sparse_grouped_tables(aggs, inputs, tmask, key, num_slots)
+
+    elif kind == "selection":
 
         def kernel(cols, params):
             tmask, _ = filter_fn(cols, params)

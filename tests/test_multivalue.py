@@ -132,6 +132,39 @@ class TestArrayLength:
         res = eng.query("SELECT city, ARRAYLENGTH(scores) FROM mv WHERE v > 97 LIMIT 50")
         assert all(isinstance(r[1], (int, np.integer)) for r in res.rows)
 
-    def test_groupby_mv_column_raises(self, eng):
-        with pytest.raises(NotImplementedError, match="multi-value"):
-            eng.query("SELECT tags, COUNT(*) FROM mv GROUP BY tags")
+    def test_groupby_mv_explode(self, eng, data):
+        """GROUP BY on an MV column explodes: each element counts once."""
+        from collections import Counter
+
+        res = eng.query("SELECT tags, COUNT(*), SUM(v) FROM mv GROUP BY tags ORDER BY tags LIMIT 100")
+        counts = Counter()
+        sums = Counter()
+        for t_list, v in zip(data["tags"], data["v"]):
+            for t in t_list:
+                counts[t] += 1
+                sums[t] += int(v)
+        got = {r[0]: (int(r[1]), int(r[2])) for r in res.rows}
+        assert got == {k: (counts[k], sums[k]) for k in counts}
+
+    def test_groupby_mv_with_sv_dim(self, eng, data):
+        from collections import Counter
+
+        res = eng.query("SELECT city, tags, COUNT(*) FROM mv GROUP BY city, tags ORDER BY city, tags LIMIT 100")
+        expected = Counter()
+        for c, t_list in zip(data["city"], data["tags"]):
+            for t in t_list:
+                expected[(c, t)] += 1
+        got = {(r[0], r[1]): int(r[2]) for r in res.rows}
+        assert got == dict(expected)
+
+    def test_groupby_mv_with_filter(self, eng, data):
+        from collections import Counter
+
+        res = eng.query("SELECT tags, COUNT(*) FROM mv WHERE v > 50 GROUP BY tags ORDER BY tags LIMIT 100")
+        expected = Counter()
+        for t_list, v in zip(data["tags"], data["v"]):
+            if v > 50:
+                for t in t_list:
+                    expected[t] += 1
+        got = {r[0]: int(r[1]) for r in res.rows}
+        assert got == dict(expected)

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from pinot_tpu.segment.segment import ImmutableSegment
 from pinot_tpu.spi.config import TableConfig
@@ -225,6 +225,63 @@ class Coordinator:
                     meta.segment_meta.pop(seg_name, None)
                     purged.append(f"{table}/{seg_name}")
         return purged
+
+    # -- liveness (Helix session-expiry analog) ---------------------------
+    def heartbeat(self, server_name: str) -> None:
+        """Servers call this periodically; check_liveness marks stale ones
+        down (the failure-DETECTION half of SURVEY §5.3 — rebalance is the
+        recovery half)."""
+        if not hasattr(self, "_heartbeats"):
+            self._heartbeats: Dict[str, float] = {}
+        self._heartbeats[server_name] = time.time()
+
+    def check_liveness(self, timeout_s: float = 30.0) -> List[str]:
+        """Mark servers with stale heartbeats down; returns who was dropped."""
+        now = time.time()
+        dropped = []
+        for name in list(self.live):
+            hb = getattr(self, "_heartbeats", {}).get(name)
+            if hb is not None and now - hb > timeout_s:
+                self.mark_down(name)
+                dropped.append(name)
+        return dropped
+
+    def run_periodic_tasks(self, heartbeat_timeout_s: float = 30.0) -> Dict[str, Any]:
+        """One tick of the controller periodic-task set
+        (ControllerPeriodicTask analog): liveness check, retention purge,
+        realtime consumption step, auto-rebalance of tables with
+        under-replicated segments, status report."""
+        dropped = self.check_liveness(heartbeat_timeout_s)
+        purged = self.run_retention()
+        consumed = self.run_realtime_consumption(max_batches=4)
+        status = self.status_report()
+        rebalanced = []
+        for table, st in status.items():
+            if st["underReplicated"] and self.live:
+                self.rebalance(table)
+                rebalanced.append(table)
+        return {
+            "serversDropped": dropped,
+            "segmentsPurged": purged,
+            "rowsConsumed": consumed,
+            "tablesRebalanced": rebalanced,
+        }
+
+    def start_periodic_tasks(self, interval_s: float = 5.0, stop_event=None) -> "threading.Thread":
+        """Background periodic-task thread (daemonized)."""
+        import threading
+
+        def loop():
+            while stop_event is None or not stop_event.is_set():
+                try:
+                    self.run_periodic_tasks()
+                except Exception:  # noqa: BLE001 — periodic tasks must not die
+                    pass
+                time.sleep(interval_s)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
 
     def status_report(self) -> Dict[str, Dict]:
         """SegmentStatusChecker: per-table replica health."""

@@ -305,6 +305,45 @@ class FirstWithTimeFunction(LastWithTimeFunction):
 
 
 # ---------------------------------------------------------------------------
+# DISTINCTSUM / DISTINCTAVG: sum/avg over the DISTINCT values
+# ---------------------------------------------------------------------------
+class DistinctSumFunction(ModeFunction):
+    """Sum of distinct values over a bounded int range (reference:
+    DistinctSumAggregationFunction).  Rides MODE's value-offset histogram:
+    distinct-sum = sum over present offsets of (lo + offset)."""
+
+    name = "distinctsum"
+
+    def bind_column(self, info: ColumnBinding):
+        bound = super().bind_column(info)
+        return DistinctSumFunction(domain=bound.domain, base=bound.base)
+
+    def final(self, p):
+        hist = np.atleast_2d(np.asarray(p["hist"]))
+        lo = np.atleast_1d(np.asarray(p["lo"], dtype=np.float64))
+        offsets = np.arange(hist.shape[1], dtype=np.float64)
+        present = hist > 0
+        out = (present * (lo[:, None] + offsets[None, :])).sum(axis=1)
+        return out[0] if np.asarray(p["hist"]).ndim == 1 else out
+
+
+class DistinctAvgFunction(DistinctSumFunction):
+    name = "distinctavg"
+
+    def bind_column(self, info: ColumnBinding):
+        bound = ModeFunction.bind_column(self, info)
+        return DistinctAvgFunction(domain=bound.domain, base=bound.base)
+
+    def final(self, p):
+        hist = np.atleast_2d(np.asarray(p["hist"]))
+        s = np.atleast_1d(DistinctSumFunction.final(self, p))
+        n = (hist > 0).sum(axis=1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = np.where(n > 0, s / n, np.nan)
+        return out[0] if np.asarray(p["hist"]).ndim == 1 else out
+
+
+# ---------------------------------------------------------------------------
 # Multi-value aggregations: COUNTMV/SUMMV/MINMV/MAXMV/AVGMV/DISTINCTCOUNTMV
 # ---------------------------------------------------------------------------
 class MVAggFunction(AggFunction):
@@ -359,6 +398,8 @@ _EXTRA = (
     PercentileLogSketchFunction,
     DistinctCountThetaFunction,
     ModeFunction,
+    DistinctSumFunction,
+    DistinctAvgFunction,
     LastWithTimeFunction,
     FirstWithTimeFunction,
 )

@@ -162,3 +162,23 @@ class TestFirstLastWithTime:
         got = eng.query("SELECT LASTWITHTIME(v, t, 'LONG') FROM lt WHERE g = 2").rows[0][0]
         m = g == 2
         assert float(got) == float(v[m][np.argmax(t[m])])
+
+
+class TestDistinctSumAvg:
+    def test_distinctsum_distinctavg(self):
+        rng = np.random.default_rng(23)
+        v = rng.integers(0, 200, 20000)
+        g = rng.integers(0, 3, 20000)
+        schema = Schema(
+            "ds",
+            [FieldSpec("g", DataType.INT), FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)],
+        )
+        eng = _make_engine({"g": g, "v": v}, schema)
+        res = eng.query("SELECT DISTINCTSUM(v), DISTINCTAVG(v) FROM ds")
+        distinct = np.unique(v)
+        assert float(res.rows[0][0]) == float(distinct.sum())
+        assert abs(float(res.rows[0][1]) - float(distinct.mean())) < 1e-9
+        res2 = eng.query("SELECT g, DISTINCTSUM(v) FROM ds GROUP BY g ORDER BY g")
+        for row in res2.rows:
+            d = np.unique(v[g == int(row[0])])
+            assert float(row[1]) == float(d.sum())

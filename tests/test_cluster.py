@@ -275,3 +275,23 @@ class TestHybridTable:
         # user filters compose with the boundary
         res2 = broker.query(f"SELECT COUNT(*) FROM h WHERE ts >= {t0 + 8}")
         assert res2.rows[0][0] == 12  # 8..19
+
+
+class TestPeriodicTasks:
+    def test_liveness_and_auto_rebalance(self):
+        import time as _time
+
+        coord = _cluster(n_servers=3, replication=2)
+        for i in range(4):
+            coord.add_segment("t", build_segment(_schema(), _data(200, seed=70 + i), f"seg{i}"))
+        for s in coord.servers:
+            coord.heartbeat(s)
+        coord._heartbeats["server2"] = _time.time() - 120  # stale
+        report = coord.run_periodic_tasks(heartbeat_timeout_s=30)
+        assert report["serversDropped"] == ["server2"]
+        assert "t" in report["tablesRebalanced"]
+        # after the tick, every segment has 2 live replicas again
+        view = coord.external_view("t")
+        assert all(len(srvs) >= 2 for srvs in view.values())
+        broker = Broker(coord)
+        assert broker.query("SELECT COUNT(*) FROM t").rows[0][0] == 800

@@ -222,6 +222,14 @@ class StackedTable:
             raise ValueError("no segments")
         schema = segments[0].schema
         names = schema.column_names
+        # Upsert segments COMPACT at stack time: rows masked out of
+        # validDocIds (replaced by newer rows elsewhere) are dropped here, so
+        # the distributed engine needs no per-row valid mask at query time —
+        # the load-time analog of the reference's UpsertCompaction minion task.
+        keeps = [
+            np.nonzero(seg.valid_docs)[0] if seg.valid_docs is not None else None
+            for seg in segments
+        ]
         # Re-decode per segment and concatenate; dictionary union via rebuild.
         data: Dict[str, np.ndarray] = {}
         null_cols: Dict[str, Optional[np.ndarray]] = {}
@@ -229,14 +237,17 @@ class StackedTable:
             parts = []
             nparts = []
             any_nulls = False
-            for seg in segments:
+            for seg, keep in zip(segments, keeps):
                 c = seg.column(name)
-                parts.append(np.asarray(c.decoded()))
+                vals = np.asarray(c.decoded())
+                nm = np.asarray(c.nulls) if c.nulls is not None else np.zeros(seg.num_docs, dtype=bool)
+                if keep is not None:
+                    vals = vals[keep]
+                    nm = nm[keep]
+                parts.append(vals)
                 if c.nulls is not None:
                     any_nulls = True
-                    nparts.append(np.asarray(c.nulls))
-                else:
-                    nparts.append(np.zeros(seg.num_docs, dtype=bool))
+                nparts.append(nm)
             data[name] = np.concatenate(parts)
             null_cols[name] = np.concatenate(nparts) if any_nulls else None
         S = num_shards or len(segments)

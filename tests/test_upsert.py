@@ -275,3 +275,30 @@ class TestPartialUpsert:
         mgr.consume_all()
         res = eng.query("SELECT SUM(clicks) FROM acct")
         assert res.rows[0][1 - 1] == 13 + 1  # a merged 3+10 across the seal, b intact
+
+
+class TestUpsertCompaction:
+    def test_from_segments_drops_invalidated_rows(self, tmp_path):
+        """Stacking upsert segments compacts validDocIds away (the
+        UpsertCompaction-at-load analog) — the distributed engine then
+        serves only the latest rows with no masks."""
+        from pinot_tpu.parallel.engine import DistributedEngine
+        from pinot_tpu.parallel.stacked import StackedTable
+
+        cfg = _config(max_rows=20)
+        stream = InMemoryStream(1)
+        mgr = RealtimeTableDataManager(_schema(), cfg, str(tmp_path / "t"), stream=stream)
+        rows = _updates(n_keys=8, n_updates=60, seed=21)
+        stream.publish_many(rows, partition=0)
+        mgr.consume_all()
+        assert len(mgr.sealed[0]) == 3
+        st = StackedTable.from_segments(mgr.sealed[0], num_shards=8)
+        eng = DistributedEngine()
+        eng.register_table("orders", st)
+        latest = _latest_per_key(rows)  # all 60 rows sealed (3 x 20)
+        conn = _golden(latest)
+        res = eng.query("SELECT COUNT(*), SUM(amount) FROM orders")
+        exp = conn.execute("SELECT COUNT(*), SUM(amount) FROM orders").fetchall()
+        from golden import assert_same_rows
+
+        assert_same_rows(res.rows, exp)

@@ -149,7 +149,7 @@ class Broker:
             for server_name, segs in assign.items():
                 deadline.check(f"query on {table}")
                 server = self.coordinator.servers[server_name]
-                res, sstats = server.execute(offline_ctx, segs)
+                res, sstats = server.execute(offline_ctx, segs, table_schema=meta.schema)
                 results.extend(res)
                 stats.num_segments_queried += sstats.num_segments_queried
                 stats.num_segments_processed += sstats.num_segments_processed

@@ -41,9 +41,11 @@ class ServerInstance:
         return list(self.segments.get(table, {}))
 
     # -- query execution (InstanceRequestHandler analog) ------------------
-    def execute(self, ctx: QueryContext, seg_names: List[str]):
+    def execute(self, ctx: QueryContext, seg_names: List[str], table_schema=None):
         """Run one query over the named LOCAL segments; returns
         (segment results, stats) — the DataTable the reference ships back."""
+        from pinot_tpu.query.planner import _needed_columns
+
         stats = ExecutionStats()
         results = []
         pending = []
@@ -53,6 +55,8 @@ class ServerInstance:
                 raise KeyError(f"server {self.name} does not serve {ctx.table}/{name}")
             stats.num_segments_queried += 1
             stats.total_docs += seg.num_docs
+            if table_schema is not None:
+                seg.ensure_columns(table_schema, _needed_columns(ctx, seg))
             if executor.prune_segment(ctx, seg):
                 stats.num_segments_pruned += 1
                 continue

@@ -100,11 +100,16 @@ class QueryEngine:
             # pipelined execution: dispatch every segment kernel (async),
             # then drain — device compute for segment k overlaps planning/
             # shipping of k+1 and the collect of earlier segments
+            from pinot_tpu.query.planner import _needed_columns
+
             pending = []
             for seg in segments:
                 deadline.check(f"query on {ctx.table}")
                 stats.num_segments_queried += 1
                 stats.total_docs += seg.num_docs
+                # schema evolution: older segments synthesize virtual
+                # default columns for schema-added fields
+                seg.ensure_columns(state.schema, _needed_columns(ctx, seg))
                 if executor.prune_segment(ctx, seg):
                     stats.num_segments_pruned += 1
                     continue

@@ -126,6 +126,35 @@ class ImmutableSegment:
         except KeyError:
             raise KeyError(f"segment {self.name} has no column {name!r}") from None
 
+    def ensure_columns(self, table_schema, names) -> None:
+        """Schema evolution: synthesize default-valued virtual columns for
+        fields the TABLE schema has but this (older) segment lacks —
+        Pinot's defaultColumnHandler behavior (missing columns read as the
+        field's default null value)."""
+        from pinot_tpu.segment.dictionary import Dictionary
+        from pinot_tpu.segment.stats import collect_stats
+
+        for name in names:
+            if name in self.columns or name not in table_schema:
+                continue
+            f = table_schema.field(name)
+            if not f.single_value:
+                raise NotImplementedError(f"virtual default for MV column {name} is unsupported")
+            default = f.data_type.null_placeholder
+            n = self.num_docs
+            if f.data_type.is_string_like:
+                dictionary, codes = Dictionary.build(f.data_type, np.asarray([default], dtype=object))
+                codes = np.zeros(n, dtype=np.uint8)
+                vals_for_stats = np.asarray([default] * min(n, 1), dtype=object)
+                stats = collect_stats(name, f.data_type, vals_for_stats, None, 1, True)
+                stats.num_docs = n
+                self.columns[name] = ColumnData(name, f.data_type, dictionary, codes, None, None, stats)
+            else:
+                arr = np.broadcast_to(f.data_type.np_dtype.type(default), (n,))
+                stats = collect_stats(name, f.data_type, np.asarray([default]), None, 1, False)
+                stats.num_docs = n
+                self.columns[name] = ColumnData(name, f.data_type, None, None, arr, None, stats)
+
     @property
     def column_names(self) -> List[str]:
         return list(self.columns)

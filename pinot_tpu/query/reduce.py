@@ -60,15 +60,28 @@ def _reduce_aggregation(ctx: QueryContext, results: List[AggSegmentResult], stat
             merged = [dict(p) for p in r.partials]
         else:
             merged = [fn.merge(m, p) for fn, m, p in zip(aggs, merged, r.partials)]
+    # finals for every aggregation (selected + hidden extras), then resolve
+    # select items — post-aggregation arithmetic evaluates over the env
+    specs = list(ctx.aggregations)
+    env: Dict[str, Any] = {}
+    for i, (spec, fn) in enumerate(zip(specs, aggs)):
+        if merged is None:
+            val = 0 if fn.name == "count" else None  # all segments pruned
+        else:
+            val = _scalar(fn.final(merged[i]))
+        env[spec.fingerprint()] = np.asarray([np.nan if val is None else val], dtype=object)
+        if spec.filter is None:
+            args = list(spec.expr and [spec.expr] or []) + [Expr.lit(a) for a in spec.literal_args]
+            env.setdefault(Expr.call(spec.function, *args).fingerprint(), env[spec.fingerprint()])
+            if spec.expr is None and not spec.literal_args:
+                env.setdefault(Expr.call(spec.function, Expr.col("*")).fingerprint(), env[spec.fingerprint()])
     row = []
-    n_selected = len(ctx.select_list)  # extras (ORDER BY/HAVING-only) don't output
-    if merged is None:
-        # all segments pruned: COUNT=0, others NULL
-        for fn in aggs[:n_selected]:
-            row.append(0 if fn.name == "count" else None)
-    else:
-        for fn, p in zip(aggs[:n_selected], merged[:n_selected]):
-            row.append(_scalar(fn.final(p)))
+    for s in ctx.select_list:
+        if isinstance(s, AggregationSpec):
+            v = env[s.fingerprint()][0]
+        else:
+            v = _eval_env_expr(s, env, 1)[0]
+        row.append(_scalar(v) if not isinstance(v, (str, bytes, type(None))) else v)
     return ResultTable(columns=ctx.column_names_out(), rows=[tuple(row)], stats=stats)
 
 
@@ -154,13 +167,11 @@ def _reduce_groupby(ctx: QueryContext, results: List[GroupBySegmentResult], stat
         env = {k: v[mask] for k, v in env.items()}
         n = int(mask.sum())
 
-    # output columns in select order
+    # output columns in select order (post-aggregation arithmetic resolves
+    # against the env of final arrays)
     out_cols: List[np.ndarray] = []
     for s in ctx.select_list:
-        fp = s.fingerprint()
-        if fp not in env:
-            raise ValueError(f"select item {s} is neither a group key nor an aggregation")
-        out_cols.append(env[fp])
+        out_cols.append(_eval_env_expr(s, env, n) if isinstance(s, Expr) else env[s.fingerprint()])
 
     rows = _rows_from_columns(out_cols, n)
     rows = _order_and_trim(ctx, rows, [s.fingerprint() for s in ctx.select_list], env, n)
@@ -366,14 +377,69 @@ def _order_and_trim(
     if ctx.order_by:
         ord_vals = []
         for ob in ctx.order_by:
-            fp = ob.expr.fingerprint()
-            if fp not in env:
-                raise ValueError(f"ORDER BY {ob.expr} must be a select/group/aggregation expression")
-            vals = env[fp]
+            try:
+                vals = _eval_env_expr(ob.expr, env, n)
+            except ValueError:
+                raise ValueError(
+                    f"ORDER BY {ob.expr} must be a select/group/aggregation expression"
+                ) from None
             ord_vals.append(np.asarray([_scalar(v) if not isinstance(v, (str, bytes, type(None))) else v for v in vals], dtype=object))
         order = _sorted_order(ctx.order_by, ord_vals, n)
         rows = [rows[i] for i in order]
     return rows[ctx.offset: ctx.offset + ctx.limit]
+
+
+_ENV_BINOPS = {
+    "plus": np.add,
+    "add": np.add,
+    "minus": np.subtract,
+    "sub": np.subtract,
+    "times": np.multiply,
+    "mult": np.multiply,
+    "mod": np.mod,
+    "pow": np.power,
+}
+_ENV_UNARY = {
+    "abs": np.abs,
+    "neg": np.negative,
+    "sqrt": np.sqrt,
+    "ln": np.log,
+    "log": np.log,
+    "log2": np.log2,
+    "log10": np.log10,
+    "exp": np.exp,
+    "floor": np.floor,
+    "ceil": np.ceil,
+    "ceiling": np.ceil,
+    "round": np.round,
+}
+
+
+def _eval_env_expr(e, env: Dict[str, np.ndarray], n: int) -> np.ndarray:
+    """POST-AGGREGATION expression evaluation over final arrays — the
+    reference's post-aggregation gap-filling (PostAggregationFunction):
+    SELECT SUM(a)/COUNT(*), HAVING SUM(v)*2 > x, ORDER BY SUM(a)-SUM(b).
+    Resolution: fingerprint in env (group keys, aggregation finals, aliases)
+    else arithmetic over recursively evaluated args."""
+    fp = e.fingerprint()
+    if fp in env:
+        return np.asarray(env[fp])
+    if e.is_literal:
+        return np.full(n, e.value)
+    if e.kind is not None and e.kind.name == "CALL":
+        op = e.op
+        if op in _ENV_BINOPS and len(e.args) == 2:
+            a = np.asarray(_eval_env_expr(e.args[0], env, n), dtype=np.float64)
+            b = np.asarray(_eval_env_expr(e.args[1], env, n), dtype=np.float64)
+            return _ENV_BINOPS[op](a, b)
+        if op in ("divide", "div") and len(e.args) == 2:
+            a = np.asarray(_eval_env_expr(e.args[0], env, n), dtype=np.float64)
+            b = np.asarray(_eval_env_expr(e.args[1], env, n), dtype=np.float64)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return a / b
+        if op in _ENV_UNARY and len(e.args) == 1:
+            return _ENV_UNARY[op](np.asarray(_eval_env_expr(e.args[0], env, n), dtype=np.float64))
+    raise ValueError(f"select item {e} is neither a group key nor an aggregation")
 
 
 def _eval_host_filter(node: FilterNode, env: Dict[str, np.ndarray], n: int) -> np.ndarray:
@@ -391,10 +457,10 @@ def _eval_host_filter(node: FilterNode, env: Dict[str, np.ndarray], n: int) -> n
     if node.op is FilterOp.NOT:
         return ~_eval_host_filter(node.children[0], env, n)
     p = node.predicate
-    fp = p.lhs.fingerprint()
-    if fp not in env:
-        raise ValueError(f"HAVING references {p.lhs}, which is not in the select/group list")
-    vals = env[fp]
+    try:
+        vals = _eval_env_expr(p.lhs, env, n)
+    except ValueError:
+        raise ValueError(f"HAVING references {p.lhs}, which is not in the select/group list") from None
 
     def isnull(v) -> bool:
         # NULL aggregates arrive as np.nan here (converted to None only at

@@ -70,6 +70,14 @@ class SqlParseError(ValueError):
     pass
 
 
+def _contains_agg(e: Expr) -> bool:
+    if not isinstance(e, Expr) or e.kind is not ExprKind.CALL:
+        return False
+    if is_agg_function(e.op):
+        return True
+    return any(_contains_agg(a) for a in e.args)
+
+
 def _filter_to_expr(node: FilterNode) -> Expr:
     """CASE condition -> boolean expression ops (__and/__or/__not/__eq/...)
     the transform layer evaluates on device."""
@@ -379,12 +387,15 @@ class _Parser:
             group_by = [s for s in select_list if isinstance(s, Expr)]
             # DISTINCT defaults to LIMIT 10 like Pinot
 
-        # Aggregations referenced by ORDER BY/HAVING but not selected are
-        # computed as hidden extras (Pinot permits ORDER BY SUM(v) without
-        # selecting it).  Top-level calls only; post-aggregation arithmetic
-        # over aggs stays unsupported here.
+        # Aggregations referenced by ORDER BY/HAVING/select EXPRESSIONS but
+        # not selected directly are computed as hidden extras (Pinot permits
+        # ORDER BY SUM(v) and post-aggregation arithmetic like
+        # SELECT SUM(a)/COUNT(*)); reduce resolves their fingerprints and
+        # evaluates the surrounding arithmetic host-side over final arrays.
         extra_aggs: List[AggregationSpec] = []
-        if group_by:
+        if group_by or any(
+            isinstance(s, Expr) and _contains_agg(s) for s in select_list
+        ):
             selected_fps = {
                 s.fingerprint() for s in select_list if isinstance(s, AggregationSpec)
             }
@@ -400,7 +411,14 @@ class _Parser:
                         spec.fingerprint() == x.fingerprint() for x in extra_aggs
                     ):
                         extra_aggs.append(spec)
+                    return
+                if isinstance(e, Expr):
+                    for a in e.args:
+                        _maybe_extra(a)
 
+            for s in select_list:
+                if isinstance(s, Expr) and s.kind is ExprKind.CALL:
+                    _maybe_extra(s)
             for o in order_by:
                 _maybe_extra(o.expr)
             if having is not None:

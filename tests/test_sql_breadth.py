@@ -180,3 +180,30 @@ class TestReviewRegressions:
         eng, conn = env
         sql = "SELECT dept, v FROM t WHERE v > 9950 ORDER BY UPPER(dept), v LIMIT 40"
         assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+
+class TestPostAggregation:
+    """Post-aggregation arithmetic (PostAggregationFunction analog)."""
+
+    def test_select_post_agg_groupby(self, env):
+        eng, conn = env
+        sql = "SELECT city, SUM(v) * 1.0 / COUNT(*) FROM t GROUP BY city ORDER BY city"
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+    def test_select_post_agg_scalar(self, env):
+        eng, conn = env
+        sql = "SELECT SUM(v) * 1.0 / COUNT(*), MAX(v) - MIN(v) FROM t"
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall())
+
+    def test_having_post_agg(self, env):
+        eng, conn = env
+        sql = (
+            "SELECT dept, COUNT(*) FROM t GROUP BY dept "
+            "HAVING SUM(v) * 1.0 / COUNT(*) > 5000 ORDER BY dept"
+        )
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+    def test_order_by_post_agg(self, env):
+        eng, conn = env
+        sql = "SELECT dept, SUM(score) FROM t GROUP BY dept ORDER BY SUM(score) * 1.0 / COUNT(*) DESC"
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)

@@ -108,6 +108,8 @@ class Broker:
         from pinot_tpu.spi.env import apply_env_defaults
 
         apply_env_defaults(ctx.options)
+        if ctx.options.get("__explain__"):
+            return self._explain(ctx)
         resolve_subqueries(ctx, self.execute)
         if ctx.set_ops:
             return apply_set_ops(ctx, self.execute)
@@ -178,6 +180,25 @@ class Broker:
         out = reduce_mod.reduce_results(ctx, results, stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         return out
+
+    def _explain(self, ctx: QueryContext) -> ResultTable:
+        """EXPLAIN PLAN FOR through the broker: reuse the engine explain
+        against one representative segment (no execution)."""
+        from pinot_tpu.query.engine import QueryEngine
+
+        meta = self.coordinator.tables[ctx.table]
+        segs = []
+        for name in list(meta.ideal)[:1]:
+            obj = self.coordinator._find_segment_object(ctx.table, name, self.coordinator.live)
+            if obj is not None:
+                segs.append(obj)
+        if not segs:
+            rt = self.coordinator.realtime.get(ctx.table)
+            if rt is not None:
+                segs = rt.query_segments()[:1]
+        shim = QueryEngine()
+        shim.register_table(meta.schema, meta.config)
+        return shim._explain(ctx, segs)
 
     def _inject_global_ranges(self, ctx: QueryContext, table: str) -> None:
         """Table-global sketch constants from broker-side metadata (the

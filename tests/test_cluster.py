@@ -295,3 +295,12 @@ class TestPeriodicTasks:
         assert all(len(srvs) >= 2 for srvs in view.values())
         broker = Broker(coord)
         assert broker.query("SELECT COUNT(*) FROM t").rows[0][0] == 800
+
+
+class TestBrokerExplain:
+    def test_explain_via_broker(self):
+        coord = _cluster(n_servers=2, replication=1)
+        coord.add_segment("t", build_segment(_schema(), _data(200, seed=99), "seg0"))
+        res = Broker(coord).query("EXPLAIN PLAN FOR SELECT city, SUM(v) FROM t WHERE city = 'sf' GROUP BY city")
+        assert res.columns == ["Operator", "Operator_Id", "Parent_Id"]
+        assert any("GROUP_BY" in r[0] for r in res.rows)

@@ -16,7 +16,11 @@ Layer map (mirrors SURVEY.md section 1, re-architected):
   parallel/  - device mesh, shard_map combine            (scatter-gather analog)
   realtime/  - mutable segments, stream consumption      (realtime analog)
   mse/       - multi-stage engine: joins, exchanges      (pinot-query-* analog)
-  cluster/   - coordinator, broker, server roles         (controller/broker/server)
+  cluster/   - coordinator, broker, server, minion, MVs  (controller/broker/server)
+  timeseries/- bucketed series engine                    (pinot-timeseries analog)
+  ingest/    - CSV/JSON record readers                   (input-format analog)
+  tools/     - admin CLI                                 (pinot-tools analog)
+(plus native/ at the repo root: first-party C++ bitmap codec + CSV scanner)
 """
 
 # OLAP semantics require 64-bit LONG/DOUBLE (Pinot aggregates into long/double;
@@ -37,3 +41,16 @@ if os.environ.get("JAX_PLATFORMS"):
 __version__ = "0.1.0"
 
 from pinot_tpu.spi.schema import DataType, FieldSpec, FieldRole, Schema  # noqa: E402,F401
+from pinot_tpu.spi.config import TableConfig  # noqa: E402,F401
+
+
+def __getattr__(name):  # lazy top-level conveniences (avoid import cycles)
+    if name == "QueryEngine":
+        from pinot_tpu.query.engine import QueryEngine
+
+        return QueryEngine
+    if name == "build_segment":
+        from pinot_tpu.segment.builder import build_segment
+
+        return build_segment
+    raise AttributeError(f"module 'pinot_tpu' has no attribute {name!r}")

@@ -188,10 +188,11 @@ class Broker:
 
         meta = self.coordinator.tables[ctx.table]
         segs = []
-        for name in list(meta.ideal)[:1]:
+        for name in meta.ideal:  # first segment with a LIVE replica
             obj = self.coordinator._find_segment_object(ctx.table, name, self.coordinator.live)
             if obj is not None:
                 segs.append(obj)
+                break
         if not segs:
             rt = self.coordinator.realtime.get(ctx.table)
             if rt is not None:

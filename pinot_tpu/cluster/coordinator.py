@@ -234,6 +234,9 @@ class Coordinator:
         if not hasattr(self, "_heartbeats"):
             self._heartbeats: Dict[str, float] = {}
         self._heartbeats[server_name] = time.time()
+        # a recovered server resumes serving (Helix session re-establishment)
+        if server_name in self.servers and server_name not in self.live:
+            self.mark_up(server_name)
 
     def check_liveness(self, timeout_s: float = 30.0) -> List[str]:
         """Mark servers with stale heartbeats down; returns who was dropped."""
@@ -271,12 +274,19 @@ class Coordinator:
         """Background periodic-task thread (daemonized)."""
         import threading
 
+        import logging
+
+        from pinot_tpu.utils.metrics import METRICS
+
+        log = logging.getLogger("pinot_tpu.cluster")
+
         def loop():
             while stop_event is None or not stop_event.is_set():
                 try:
                     self.run_periodic_tasks()
                 except Exception:  # noqa: BLE001 — periodic tasks must not die
-                    pass
+                    METRICS.counter("periodicTaskExceptions").inc()
+                    log.exception("periodic task tick failed")
                 time.sleep(interval_s)
 
         t = threading.Thread(target=loop, daemon=True)

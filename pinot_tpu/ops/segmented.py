@@ -202,6 +202,66 @@ def _entry_fallback(kind, values, mask, codes, num_groups):
     return group_sum_sq(values, mask, codes, num_groups)
 
 
+# row-length limb stacks past this size extract in-chunk instead of
+# materializing [n, L] in HBM (see fused_group_tables)
+_FUSED_STACK_BYTES = 1 << 31
+
+
+def _entry_width(kind, limb_plan) -> int:
+    """Limb-column count _entry_limbs will produce for this entry."""
+    if kind == "count":
+        return 1
+    if kind == "int_sum":
+        n_limbs, signed = limb_plan if limb_plan is not None else (4, True)
+        return n_limbs + (1 if signed else 0)
+    return 1
+
+
+def _fused_scan_inchunk(entries, codes, num_groups, dt, H):
+    """fused_group_tables' scan with PER-CHUNK limb extraction: the raw
+    (values, mask) row arrays stream through the scan and limbs materialize
+    only at chunk granularity in VMEM."""
+    operands = []
+    for kind, values, mask, limb_plan in entries:
+        v = values if values is not None else mask
+        operands.extend([v, mask])
+    padded = _pad_to_chunks(*operands, _i32(codes))
+    *ent_ops, codes_p = padded
+    xs = tuple(a.reshape(-1, _CHUNK, *a.shape[1:]) for a in ent_ops) + (
+        codes_p.reshape(-1, _CHUNK),
+    )
+    slices = []
+    L = 0
+    for kind, _, _, limb_plan in entries:
+        w = _entry_width(kind, limb_plan)
+        slices.append((L, None))  # scales filled from the first chunk below
+        L += w
+
+    scale_box = []
+
+    def body(acc, xs_chunk):
+        *flat_ops, ki = xs_chunk
+        cols = []
+        for ei, (kind, _, _, limb_plan) in enumerate(entries):
+            vi, mi = flat_ops[2 * ei], flat_ops[2 * ei + 1]
+            ecols, scales = _entry_limbs(kind, vi, mi, limb_plan, dt)
+            if len(scale_box) == ei:  # python-level capture at trace time
+                scale_box.append(scales)
+            cols.extend(ecols)
+        li = jnp.stack(cols, axis=1)
+        hi = ki // np.int32(_W)
+        lo = ki % np.int32(_W)
+        A = jax.nn.one_hot(hi, H, dtype=dt)
+        B = jax.nn.one_hot(lo, _W, dtype=dt)
+        S = jnp.einsum("cl,ch,cw->lhw", li, A, B, preferred_element_type=jnp.float32)
+        return acc + S.astype(jnp.float64), None
+
+    acc, _ = lax.scan(body, jnp.zeros((L, H, _W), jnp.float64), xs)
+    flat = acc.reshape(L, H * _W)[:, :num_groups]
+    slices = [(start, scale_box[ei]) for ei, (start, _) in enumerate(slices)]
+    return flat, slices
+
+
 def _entry_limbs(kind, values, mask, limb_plan, dt):
     """-> (list of [n] limb columns in dtype dt, list of f64 scales)."""
     if kind == "count":
@@ -239,32 +299,41 @@ def fused_group_tables(entries, codes, num_groups: int):
 
     use_f32 = any(k in ("f32_sum", "f32_sumsq") for k, _, _, _ in entries)
     dt = jnp.float32 if use_f32 else jnp.bfloat16
-
-    cols = []
-    slices = []  # per entry: (start, scales)
-    for kind, values, mask, limb_plan in entries:
-        ecols, scales = _entry_limbs(kind, values, mask, limb_plan, dt)
-        slices.append((len(cols), scales))
-        cols.extend(ecols)
-
     H = -(-num_groups // _W)
-    L = len(cols)
-    stacked = jnp.stack(cols, axis=1)  # [n, L]
-    stacked, codes = _pad_to_chunks(stacked, _i32(codes))
-    v_r = stacked.reshape(-1, _CHUNK, L)
-    k_r = codes.reshape(-1, _CHUNK)
 
-    def body(acc, xs):
-        li, ki = xs
-        hi = ki // np.int32(_W)
-        lo = ki % np.int32(_W)
-        A = jax.nn.one_hot(hi, H, dtype=dt)  # [C, H]
-        B = jax.nn.one_hot(lo, _W, dtype=dt)  # [C, W]
-        S = jnp.einsum("cl,ch,cw->lhw", li, A, B, preferred_element_type=jnp.float32)
-        return acc + S.astype(jnp.float64), None
+    # Estimate the [n, L] stacked-limb footprint; past the budget, limbs
+    # extract INSIDE the scan body from the raw (values, mask) chunks —
+    # VMEM-resident, ~25% slower per chunk but it removes the multi-GB HBM
+    # intermediate that OOMed the 1B-row bench.
+    n_rows = codes.shape[0]
+    L = sum(_entry_width(kind, limb_plan) for kind, _, _, limb_plan in entries)
+    stack_bytes = n_rows * L * jnp.dtype(dt).itemsize
+    if stack_bytes > _FUSED_STACK_BYTES:
+        flat, slices = _fused_scan_inchunk(entries, codes, num_groups, dt, H)
+    else:
+        cols = []
+        slices = []  # per entry: (start, scales)
+        for kind, values, mask, limb_plan in entries:
+            ecols, scales = _entry_limbs(kind, values, mask, limb_plan, dt)
+            slices.append((len(cols), scales))
+            cols.extend(ecols)
 
-    acc, _ = lax.scan(body, jnp.zeros((L, H, _W), jnp.float64), (v_r, k_r))
-    flat = acc.reshape(L, H * _W)[:, :num_groups]
+        stacked = jnp.stack(cols, axis=1)  # [n, L]
+        stacked, codes = _pad_to_chunks(stacked, _i32(codes))
+        v_r = stacked.reshape(-1, _CHUNK, L)
+        k_r = codes.reshape(-1, _CHUNK)
+
+        def body(acc, xs):
+            li, ki = xs
+            hi = ki // np.int32(_W)
+            lo = ki % np.int32(_W)
+            A = jax.nn.one_hot(hi, H, dtype=dt)  # [C, H]
+            B = jax.nn.one_hot(lo, _W, dtype=dt)  # [C, W]
+            S = jnp.einsum("cl,ch,cw->lhw", li, A, B, preferred_element_type=jnp.float32)
+            return acc + S.astype(jnp.float64), None
+
+        acc, _ = lax.scan(body, jnp.zeros((L, H, _W), jnp.float64), (v_r, k_r))
+        flat = acc.reshape(L, H * _W)[:, :num_groups]
 
     out = []
     for start, scales in slices:

@@ -13,7 +13,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from pinot_tpu.query import executor, reduce as reduce_mod
-from pinot_tpu.query.ir import QueryContext
+from pinot_tpu.query.ir import Expr, QueryContext
 from pinot_tpu.query.result import ExecutionStats, ResultTable
 from pinot_tpu.segment.segment import ImmutableSegment
 from pinot_tpu.spi.config import TableConfig
@@ -108,8 +108,13 @@ class QueryEngine:
                 stats.num_segments_queried += 1
                 stats.total_docs += seg.num_docs
                 # schema evolution: older segments synthesize virtual
-                # default columns for schema-added fields
-                seg.ensure_columns(state.schema, _needed_columns(ctx, seg))
+                # default columns for schema-added fields; SELECT * covers
+                # the FULL table schema (review-caught: per-segment schemas
+                # would drop/crash on added columns)
+                needed = _needed_columns(ctx, seg)
+                if any(isinstance(s, Expr) and s.is_column and s.op == "*" for s in ctx.select_list):
+                    needed = list(dict.fromkeys(list(needed) + state.schema.column_names))
+                seg.ensure_columns(state.schema, needed)
                 if executor.prune_segment(ctx, seg):
                     stats.num_segments_pruned += 1
                     continue

@@ -272,6 +272,15 @@ def guard_sparse_vector_fields(kind: str, aggs: List[AggFunction]) -> None:
         )
 
 
+def _all_column_names(segment) -> List[str]:
+    """All queryable columns, INCLUDING schema-evolution virtuals the
+    segment's own (older) schema does not list."""
+    cols = getattr(segment, "columns", None)
+    if isinstance(cols, dict):
+        return list(cols)
+    return segment.schema.column_names
+
+
 def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
     cols: List[str] = []
     if ctx.filter:
@@ -316,7 +325,7 @@ def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
     seen, out = set(), []
     for c in cols:
         if c == "*":
-            for name in segment.schema.column_names:
+            for name in _all_column_names(segment):
                 if name not in seen:
                     seen.add(name)
                     out.append(name)
@@ -822,8 +831,13 @@ def _build_plan(
     mv_dims = [i for i, gd in enumerate(group_dims) if gd.mv]
     if len(mv_dims) > 1:
         raise NotImplementedError("at most one multi-value GROUP BY dimension (explode) per query")
-    if mv_dims and any(getattr(fn_, "mv_input", False) for fn_ in aggs):
-        raise NotImplementedError("MV aggregations cannot combine with an MV GROUP BY dimension")
+    if mv_dims and any(
+        getattr(fn_, "mv_input", False) or getattr(fn_, "needs_extra_exprs", False) for fn_ in aggs
+    ):
+        raise NotImplementedError(
+            "MV/tuple-input aggregations (SUMMV..., FIRST/LASTWITHTIME) cannot combine "
+            "with an MV GROUP BY dimension"
+        )
     mv_i = mv_dims[0] if mv_dims else None
 
     def _mv_explode(cols, params, tmask, key_dtype):
@@ -914,7 +928,7 @@ def _build_plan(
             if not isinstance(s, Expr):
                 raise NotImplementedError(f"unsupported selection item {s}")
             if s.is_column and s.op == "*":
-                select_exprs.extend(Expr.col(n) for n in segment.schema.column_names)
+                select_exprs.extend(Expr.col(n) for n in _all_column_names(segment))
             else:
                 select_exprs.append(s)
         select_columns = [e.op for e in select_exprs if isinstance(e, Expr) and e.is_column]

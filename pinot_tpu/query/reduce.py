@@ -69,12 +69,7 @@ def _reduce_aggregation(ctx: QueryContext, results: List[AggSegmentResult], stat
             val = 0 if fn.name == "count" else None  # all segments pruned
         else:
             val = _scalar(fn.final(merged[i]))
-        env[spec.fingerprint()] = np.asarray([np.nan if val is None else val], dtype=object)
-        if spec.filter is None:
-            args = list(spec.expr and [spec.expr] or []) + [Expr.lit(a) for a in spec.literal_args]
-            env.setdefault(Expr.call(spec.function, *args).fingerprint(), env[spec.fingerprint()])
-            if spec.expr is None and not spec.literal_args:
-                env.setdefault(Expr.call(spec.function, Expr.col("*")).fingerprint(), env[spec.fingerprint()])
+        _register_agg_env(env, spec, np.asarray([np.nan if val is None else val], dtype=object))
     row = []
     for s in ctx.select_list:
         if isinstance(s, AggregationSpec):
@@ -91,6 +86,19 @@ def _scalar(v):
     if isinstance(x, float) and (math.isnan(x) or math.isinf(x)):
         return None
     return x
+
+
+def _register_agg_env(env: Dict[str, Any], spec: AggregationSpec, finals) -> None:
+    """Register one aggregation's final array under every fingerprint form
+    HAVING/ORDER BY/post-aggregation may reference it by: the spec itself,
+    the plain call `sum(v)` (literal args re-attached), and explicit
+    `count(*)`.  Shared by the scalar and group-by reducers."""
+    env[spec.fingerprint()] = finals
+    if spec.filter is None:
+        args = list(spec.expr and [spec.expr] or []) + [Expr.lit(a) for a in spec.literal_args]
+        env.setdefault(Expr.call(spec.function, *args).fingerprint(), finals)
+        if spec.expr is None and not spec.literal_args:
+            env.setdefault(Expr.call(spec.function, Expr.col("*")).fingerprint(), finals)
 
 
 # ---------------------------------------------------------------------------
@@ -141,15 +149,7 @@ def _reduce_groupby(ctx: QueryContext, results: List[GroupBySegmentResult], stat
     for g, k in zip(ctx.group_by, keys):
         env[g.fingerprint()] = k
     for spec, f in zip(ctx.aggregations, finals):
-        env[spec.fingerprint()] = f
-        # HAVING/ORDER BY reference aggregations as plain calls: sum(v),
-        # percentile(v, 95) — literal args re-attach as literal exprs.
-        if spec.filter is None:
-            args = list(spec.expr and [spec.expr] or []) + [Expr.lit(a) for a in spec.literal_args]
-            env.setdefault(Expr.call(spec.function, *args).fingerprint(), f)
-            if spec.expr is None and not spec.literal_args:
-                # `count(*)` written explicitly (parser form)
-                env.setdefault(Expr.call(spec.function, Expr.col("*")).fingerprint(), f)
+        _register_agg_env(env, spec, f)
     # select aliases: ORDER BY/HAVING may reference any select item by alias
     # (covers filtered/literal-arg aggregations the call forms above can't)
     for s, alias in zip(ctx.select_list, ctx.select_aliases):

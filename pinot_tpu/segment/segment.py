@@ -127,10 +127,13 @@ class ImmutableSegment:
             raise KeyError(f"segment {self.name} has no column {name!r}") from None
 
     def ensure_columns(self, table_schema, names) -> None:
-        """Schema evolution: synthesize default-valued virtual columns for
-        fields the TABLE schema has but this (older) segment lacks —
-        Pinot's defaultColumnHandler behavior (missing columns read as the
-        field's default null value)."""
+        """Schema evolution: synthesize virtual columns for fields the TABLE
+        schema has but this (older) segment lacks.  Old rows read as SQL
+        NULL (null mask all-set over the type placeholder) — a documented
+        delta from Pinot's defaultColumnHandler, whose legacy semantics
+        return the default VALUE; with this engine's SQL-standard null
+        handling, placeholder values leaking into SUM/MIN would corrupt
+        aggregates (review-caught)."""
         from pinot_tpu.segment.dictionary import Dictionary
         from pinot_tpu.segment.stats import collect_stats
 
@@ -142,18 +145,18 @@ class ImmutableSegment:
                 raise NotImplementedError(f"virtual default for MV column {name} is unsupported")
             default = f.data_type.null_placeholder
             n = self.num_docs
+            nulls = np.ones(n, dtype=bool)
             if f.data_type.is_string_like:
-                dictionary, codes = Dictionary.build(f.data_type, np.asarray([default], dtype=object))
+                dictionary, _ = Dictionary.build(f.data_type, np.asarray([default], dtype=object))
                 codes = np.zeros(n, dtype=np.uint8)
-                vals_for_stats = np.asarray([default] * min(n, 1), dtype=object)
-                stats = collect_stats(name, f.data_type, vals_for_stats, None, 1, True)
+                stats = collect_stats(name, f.data_type, np.asarray([default], dtype=object), None, 1, True)
                 stats.num_docs = n
-                self.columns[name] = ColumnData(name, f.data_type, dictionary, codes, None, None, stats)
+                self.columns[name] = ColumnData(name, f.data_type, dictionary, codes, None, nulls, stats)
             else:
                 arr = np.broadcast_to(f.data_type.np_dtype.type(default), (n,))
                 stats = collect_stats(name, f.data_type, np.asarray([default]), None, 1, False)
                 stats.num_docs = n
-                self.columns[name] = ColumnData(name, f.data_type, None, None, arr, None, stats)
+                self.columns[name] = ColumnData(name, f.data_type, None, None, arr, nulls, stats)
 
     @property
     def column_names(self) -> List[str]:

@@ -63,3 +63,79 @@ def test_unimplemented_agg_clear_error():
 def test_sum_of_pure_literal(eng):
     r = eng.query("SELECT SUM(1) FROM t")
     assert r.rows[0][0] == 6
+
+
+class TestRound4EdgeCases:
+    def test_empty_table_paths(self):
+        import numpy as np
+
+        from pinot_tpu.query.engine import QueryEngine
+        from pinot_tpu.segment.builder import build_segment
+        from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+        schema = Schema(
+            "e", [FieldSpec("c", DataType.STRING), FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)]
+        )
+        eng = QueryEngine()
+        eng.register_table(schema)
+        # no segments at all
+        assert eng.query("SELECT COUNT(*) FROM e").rows[0][0] == 0
+        assert eng.query("SELECT c, SUM(v) FROM e GROUP BY c").rows == []
+        assert eng.query("SELECT c FROM e LIMIT 5").rows == []
+        res = eng.query("EXPLAIN PLAN FOR SELECT COUNT(*) FROM e")
+        assert res.rows  # explain of an empty table still yields a plan row
+        # window + set ops on empty
+        assert eng.query("SELECT c, ROW_NUMBER() OVER (ORDER BY v) FROM e LIMIT 5").rows == []
+        assert eng.query("SELECT c FROM e UNION SELECT c FROM e LIMIT 5").rows == []
+
+    def test_zero_row_segment(self):
+        import numpy as np
+
+        from pinot_tpu.query.engine import QueryEngine
+        from pinot_tpu.segment.builder import build_segment
+        from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+        schema = Schema(
+            "z", [FieldSpec("c", DataType.STRING), FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)]
+        )
+        seg = build_segment(schema, {"c": np.array([], dtype=object), "v": np.array([], dtype=np.int64)}, "s0")
+        eng = QueryEngine()
+        eng.register_table(schema)
+        eng.add_segment("z", seg)
+        assert eng.query("SELECT COUNT(*), SUM(v) FROM z").rows[0][0] == 0
+
+    def test_case_everything_null(self):
+        import numpy as np
+
+        from pinot_tpu.query.engine import QueryEngine
+        from pinot_tpu.segment.builder import build_segment
+        from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+        schema = Schema("n", [FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)])
+        eng = QueryEngine()
+        eng.register_table(schema)
+        eng.add_segment("n", build_segment(schema, {"v": np.arange(10)}, "s0"))
+        # no WHEN matches and no ELSE: all NULL -> SUM is NULL, COUNT 0
+        res = eng.query("SELECT SUM(CASE WHEN v > 100 THEN v END), COUNT(CASE WHEN v > 100 THEN v END) FROM n")
+        assert res.rows[0][0] is None
+        assert res.rows[0][1] == 0
+
+    def test_post_agg_divide_by_zero_group(self):
+        import numpy as np
+
+        from pinot_tpu.query.engine import QueryEngine
+        from pinot_tpu.segment.builder import build_segment
+        from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+        schema = Schema(
+            "d", [FieldSpec("g", DataType.STRING), FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)]
+        )
+        eng = QueryEngine()
+        eng.register_table(schema)
+        eng.add_segment(
+            "d", build_segment(schema, {"g": np.array(["a", "b"], dtype=object), "v": np.array([5, 0])}, "s0")
+        )
+        # SUM(v)/SUM(v) where group b sums to 0 -> NULL, not a crash
+        res = eng.query("SELECT g, SUM(v) * 1.0 / SUM(v) FROM d GROUP BY g ORDER BY g")
+        assert res.rows[0][1] == 1.0
+        assert res.rows[1][1] is None

@@ -225,7 +225,10 @@ def _fused_scan_inchunk(entries, codes, num_groups, dt, H):
     for kind, values, mask, limb_plan in entries:
         v = values if values is not None else mask
         operands.extend([v, mask])
-    padded = _pad_to_chunks(*operands, _i32(codes))
+    # codes stay in storage dtype (u16/u8) until per-chunk — a full-array
+    # i32 cast materializes gigabytes at 1B rows (HBM-OOM review of the
+    # 1B bench); the chunk body casts its 64k slice only
+    padded = _pad_to_chunks(*operands, codes)
     *ent_ops, codes_p = padded
     xs = tuple(a.reshape(-1, _CHUNK, *a.shape[1:]) for a in ent_ops) + (
         codes_p.reshape(-1, _CHUNK),
@@ -249,6 +252,7 @@ def _fused_scan_inchunk(entries, codes, num_groups, dt, H):
                 scale_box.append(scales)
             cols.extend(ecols)
         li = jnp.stack(cols, axis=1)
+        ki = _i32(ki)
         hi = ki // np.int32(_W)
         lo = ki % np.int32(_W)
         A = jax.nn.one_hot(hi, H, dtype=dt)
@@ -319,12 +323,15 @@ def fused_group_tables(entries, codes, num_groups: int):
             cols.extend(ecols)
 
         stacked = jnp.stack(cols, axis=1)  # [n, L]
-        stacked, codes = _pad_to_chunks(stacked, _i32(codes))
+        # codes keep their storage dtype; the body casts one chunk at a time
+        # (a full-array i32 cast is a multi-GB HBM temp at 1B rows)
+        stacked, codes = _pad_to_chunks(stacked, codes)
         v_r = stacked.reshape(-1, _CHUNK, L)
         k_r = codes.reshape(-1, _CHUNK)
 
         def body(acc, xs):
             li, ki = xs
+            ki = _i32(ki)
             hi = ki // np.int32(_W)
             lo = ki % np.int32(_W)
             A = jax.nn.one_hot(hi, H, dtype=dt)  # [C, H]

@@ -282,6 +282,8 @@ class DistributedEngine:
         _agg_inputs = make_agg_inputs(agg_specs, aggs, agg_filter_fns, view, stacked, null_handling)
 
         def _group_key(cols):
+            if len(group_dims) == 1 and group_dims[0].kind == "dict":
+                return cols[group_dims[0].name]["codes"]  # cast per chunk in ops
             key = None
             for gd in group_dims:
                 code = gd.device_code(cols, view, jnp.int32)

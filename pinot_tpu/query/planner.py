@@ -816,6 +816,9 @@ def _build_plan(
         return out
 
     def _group_key(cols, params):
+        if len(group_dims) == 1 and group_dims[0].kind == "dict":
+            # storage-dtype passthrough: the group kernels cast per chunk
+            return cols[group_dims[0].name]["codes"]
         key = None
         for gd in group_dims:
             code = gd.device_code(cols, segment, jnp.int32)

@@ -19,10 +19,12 @@ kernel whose stage boundaries are XLA collectives:
   join:      sorted-build + searchsorted probe (mse/join.py)
   aggregate: the existing fused dense group-table kernels + psum combine
 
-Scope (round 3 seed): star joins — FROM fact JOIN dim ON fact.fk = dim.pk —
-with unique build-side keys, INNER/LEFT, aggregation or group-by on fact
-and/or dim attributes.  Many-to-many joins, snowflake chains, join output
-selection, and cross-table predicates raise JoinPlanError/NotImplementedError.
+Scope: star joins — FROM fact JOIN dim ON fact.fk = dim.pk — INNER/LEFT,
+aggregation or group-by on fact and/or dim attributes; build sides may have
+NON-unique keys up to a bounded multiplicity (range_join expansion,
+joinMaxDup, broadcast strategy, at most one such join per query).
+Snowflake chains, join-output selection, and cross-table predicates raise
+JoinPlanError/NotImplementedError.
 """
 from __future__ import annotations
 

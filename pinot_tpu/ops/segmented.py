@@ -218,49 +218,69 @@ def _entry_width(kind, limb_plan) -> int:
 
 
 def _fused_scan_inchunk(entries, codes, num_groups, dt, H):
-    """fused_group_tables' scan with PER-CHUNK limb extraction: the raw
-    (values, mask) row arrays stream through the scan and limbs materialize
-    only at chunk granularity in VMEM."""
+    """fused_group_tables' loop with PER-CHUNK limb extraction and
+    dynamic_slice reads straight out of the ORIGINAL flat arrays.
+
+    No [n, L] limb stack, no pad copy, no scan-operand reshape copies —
+    every one of those materialized gigabytes of HLO temps at 1B rows
+    (three HBM-OOM post-mortems of the 1B bench).  The tail chunk slices
+    from n - CHUNK with already-covered head rows masked off, so unaligned
+    row counts need no padding."""
+    n = codes.shape[0]
     operands = []
     for kind, values, mask, limb_plan in entries:
         v = values if values is not None else mask
-        operands.extend([v, mask])
-    # codes stay in storage dtype (u16/u8) until per-chunk — a full-array
-    # i32 cast materializes gigabytes at 1B rows (HBM-OOM review of the
-    # 1B bench); the chunk body casts its 64k slice only
-    padded = _pad_to_chunks(*operands, codes)
-    *ent_ops, codes_p = padded
-    xs = tuple(a.reshape(-1, _CHUNK, *a.shape[1:]) for a in ent_ops) + (
-        codes_p.reshape(-1, _CHUNK),
-    )
+        operands.append((v, mask))
     slices = []
     L = 0
     for kind, _, _, limb_plan in entries:
         w = _entry_width(kind, limb_plan)
-        slices.append((L, None))  # scales filled from the first chunk below
+        slices.append((L, None))  # scales captured at trace time below
         L += w
 
+    num_chunks = max(1, -(-n // _CHUNK))
     scale_box = []
+    iota = jnp.arange(_CHUNK, dtype=jnp.int32)
 
-    def body(acc, xs_chunk):
-        *flat_ops, ki = xs_chunk
+    def body(i, acc):
+        start = jnp.minimum(i * _CHUNK, np.int32(max(0, n - _CHUNK)))
+        # rows already covered by the previous chunk (tail overlap) drop out
+        fresh = (start + iota) >= i * _CHUNK
+        ki = _i32(lax.dynamic_slice_in_dim(codes, start, _CHUNK))
         cols = []
         for ei, (kind, _, _, limb_plan) in enumerate(entries):
-            vi, mi = flat_ops[2 * ei], flat_ops[2 * ei + 1]
+            v, m = operands[ei]
+            vi = lax.dynamic_slice_in_dim(v, start, _CHUNK)
+            mi = lax.dynamic_slice_in_dim(m, start, _CHUNK) & fresh
             ecols, scales = _entry_limbs(kind, vi, mi, limb_plan, dt)
             if len(scale_box) == ei:  # python-level capture at trace time
                 scale_box.append(scales)
             cols.extend(ecols)
         li = jnp.stack(cols, axis=1)
-        ki = _i32(ki)
         hi = ki // np.int32(_W)
         lo = ki % np.int32(_W)
         A = jax.nn.one_hot(hi, H, dtype=dt)
         B = jax.nn.one_hot(lo, _W, dtype=dt)
         S = jnp.einsum("cl,ch,cw->lhw", li, A, B, preferred_element_type=jnp.float32)
-        return acc + S.astype(jnp.float64), None
+        return acc + S.astype(jnp.float64)
 
-    acc, _ = lax.scan(body, jnp.zeros((L, H, _W), jnp.float64), xs)
+    if n < _CHUNK:
+        # single undersized chunk: fall back to padded one-shot
+        ops_p = _pad_to_chunks(*[a for pair in operands for a in pair], codes)
+        *ent_ops, codes_p = ops_p
+        cols = []
+        for ei, (kind, _, _, limb_plan) in enumerate(entries):
+            ecols, scales = _entry_limbs(kind, ent_ops[2 * ei], ent_ops[2 * ei + 1], limb_plan, dt)
+            if len(scale_box) == ei:
+                scale_box.append(scales)
+            cols.extend(ecols)
+        li = jnp.stack(cols, axis=1)
+        ki = _i32(codes_p)
+        A = jax.nn.one_hot(ki // np.int32(_W), H, dtype=dt)
+        B = jax.nn.one_hot(ki % np.int32(_W), _W, dtype=dt)
+        acc = jnp.einsum("cl,ch,cw->lhw", li, A, B, preferred_element_type=jnp.float32).astype(jnp.float64)
+    else:
+        acc = lax.fori_loop(0, num_chunks, body, jnp.zeros((L, H, _W), jnp.float64))
     flat = acc.reshape(L, H * _W)[:, :num_groups]
     slices = [(start, scale_box[ei]) for ei, (start, _) in enumerate(slices)]
     return flat, slices

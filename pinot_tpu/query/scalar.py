@@ -390,6 +390,65 @@ def _json_extract(values: np.ndarray, path, rtype, default=None) -> np.ndarray:
 
 DICT_FNS["json_extract_scalar"] = _json_extract
 
+
+def _java_fmt_to_strptime(fmt: str) -> str:
+    """Joda/SimpleDateFormat pattern -> strptime (the subset Pinot docs use:
+    yyyy MM dd HH mm ss SSS)."""
+    out = fmt
+    for a, b in (
+        ("yyyy", "%Y"),
+        ("MM", "%m"),
+        ("dd", "%d"),
+        ("HH", "%H"),
+        ("mm", "%M"),
+        ("ss", "%S"),
+    ):
+        out = out.replace(a, b)
+    return out.replace("SSS", "%f")  # strptime %f = microseconds; see below
+
+
+def _from_datetime(values: np.ndarray, fmt: str) -> np.ndarray:
+    """FROMDATETIME(strCol, 'yyyy-MM-dd ...') -> epoch millis (UTC).
+    Runs over the DICTIONARY (cardinality work) like all string functions."""
+    import datetime as _dt
+
+    py_fmt = _java_fmt_to_strptime(str(fmt))
+    has_millis = "%f" in py_fmt
+    out = np.empty(len(values), dtype=np.int64)
+    for i, v in enumerate(values):
+        s = str(v)
+        if has_millis:
+            # SSS is milliseconds; pad to microseconds for %f
+            base, _, frac = s.rpartition(".")
+            if base and len(frac) == 3:
+                s = f"{base}.{frac}000"
+        try:
+            d = _dt.datetime.strptime(s, py_fmt).replace(tzinfo=_dt.timezone.utc)
+            out[i] = int(d.timestamp() * 1000)
+        except ValueError:
+            out[i] = np.iinfo(np.int64).min  # unparseable -> placeholder
+    return out
+
+
+DICT_FNS["fromdatetime"] = _from_datetime
+
+
+def to_datetime(ms, fmt: str):
+    """TODATETIME(epochMillis, fmt) -> formatted string (host/selection path;
+    strings never materialize on device)."""
+    import datetime as _dt
+
+    py_fmt = _java_fmt_to_strptime(str(fmt))
+    out = np.empty(len(ms), dtype=object)
+    for i, v in enumerate(np.asarray(ms)):
+        d = _dt.datetime.fromtimestamp(int(v) / 1000, tz=_dt.timezone.utc)
+        s = d.strftime(py_fmt)
+        if "%f" in py_fmt:
+            # strftime %f gives microseconds; SSS wants milliseconds
+            s = s.replace(d.strftime("%f"), d.strftime("%f")[:3])
+        out[i] = s
+    return out
+
 STRING_RESULT_DICT_FNS = frozenset(
     {"upper", "lower", "trim", "ltrim", "rtrim", "reverse", "substr", "substring", "concat", "replace", "lpad", "rpad"}
 )
@@ -529,6 +588,16 @@ def expr_int_range(expr, segment) -> Optional[Tuple[int, int]]:
         if lits2:
             n = 1 << int(lits2[-1])
             return (0, n * n - 1)
+        return None
+    # numeric dictionary-domain functions (LENGTH, STRPOS, FROMDATETIME...):
+    # bound by evaluating the derived array over the dictionary itself
+    if is_dict_fn_expr(expr) and not string_result(expr):
+        col = next(a for a in expr.args if not a.is_literal).op
+        c = segment.column(col)
+        if c.has_dictionary and c.dictionary.cardinality:
+            derived = eval_dict_fn(expr, c.dictionary.values)
+            if np.issubdtype(np.asarray(derived).dtype, np.integer):
+                return (int(derived.min()), int(derived.max()))
         return None
     if op in ("plus", "add", "minus", "sub", "times", "mult") and len(expr.args) == 2:
         ra = expr_int_range(expr.args[0], segment)

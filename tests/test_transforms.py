@@ -263,3 +263,39 @@ class TestCaseWhen:
         res = e.query("SELECT SUM(CASE WHEN x > 0 THEN nv ELSE 0 END), COUNT(CASE WHEN x > 0 THEN nv ELSE 0 END) FROM cn")
         assert res.rows[0][0] == 5    # NULL row skipped, ELSE-0 rows counted as 0
         assert res.rows[0][1] == 3    # one row (row 0) is genuinely NULL
+
+
+class TestSdfDatetime:
+    """FROMDATETIME / TODATETIME (SimpleDateFormat conversions)."""
+
+    def test_fromdatetime_filter_and_groupby(self):
+        import datetime as dt2
+
+        schema = Schema(
+            "sd",
+            [FieldSpec("day", DataType.STRING), FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)],
+        )
+        rng2 = np.random.default_rng(9)
+        days = [f"2024-0{m}-1{d}" for m in range(1, 4) for d in range(3)]
+        data = {"day": rng2.choice(days, 2000).astype(object), "v": rng2.integers(0, 10, 2000)}
+        e = QueryEngine()
+        e.register_table(schema)
+        e.add_segment("sd", build_segment(schema, data, "s0"))
+        cutoff = int(dt2.datetime(2024, 2, 1, tzinfo=dt2.timezone.utc).timestamp() * 1000)
+        res = e.query(f"SELECT COUNT(*) FROM sd WHERE FROMDATETIME(day, 'yyyy-MM-dd') >= {cutoff}")
+        expected = sum(1 for s in data["day"] if not s.startswith("2024-01"))
+        assert res.rows[0][0] == expected
+        # group by the parsed epoch (numeric dict-fn interval bound)
+        res2 = e.query(
+            "SELECT FROMDATETIME(day, 'yyyy-MM-dd'), COUNT(*) FROM sd "
+            "GROUP BY FROMDATETIME(day, 'yyyy-MM-dd') ORDER BY FROMDATETIME(day, 'yyyy-MM-dd') LIMIT 20"
+        )
+        assert len(res2.rows) == len(set(data["day"]))
+
+    def test_todatetime_selection(self, eng):
+        res = eng.query("SELECT ts, TODATETIME(ts, 'yyyy-MM-dd HH:mm:ss') FROM ev LIMIT 20")
+        import datetime as dt2
+
+        for row in res.rows:
+            d = dt2.datetime.fromtimestamp(row[0] / 1000, tz=dt2.timezone.utc)
+            assert row[1] == d.strftime("%Y-%m-%d %H:%M:%S")

@@ -260,12 +260,15 @@ class WindowSpec:
     expr: Optional[Expr]
     partition_by: Tuple[Expr, ...] = ()
     order_by: Tuple[OrderByExpr, ...] = ()
+    # "range_all" = whole partition; "rows_cumulative" = ROWS BETWEEN
+    # UNBOUNDED PRECEDING AND CURRENT ROW (running aggregate)
+    frame: str = "range_all"
 
     def fingerprint(self) -> str:
         e = self.expr.fingerprint() if self.expr else "*"
         p = "|".join(x.fingerprint() for x in self.partition_by)
         o = "|".join(f"{x.expr.fingerprint()}:{x.ascending}" for x in self.order_by)
-        return f"win:{self.function}({e})p[{p}]o[{o}]"
+        return f"win:{self.function}({e})p[{p}]o[{o}]f[{self.frame}]"
 
     def __str__(self) -> str:
         return f"{self.function}() OVER (...)"

@@ -313,6 +313,8 @@ def _compute_window(spec, arrays: Dict[str, np.ndarray], n: int) -> np.ndarray:
                     prev_key = key
             out[idx] = pos if fn == "row_number" else (rank if fn == "rank" else dense)
         return out.astype(np.int64)
+    if spec.frame == "rows_cumulative":
+        return _running_window(fn, pid, okeys, arg, n)
     # whole-partition aggregates
     nparts = int(pid.max()) + 1 if n else 0
     if fn == "count":
@@ -330,6 +332,53 @@ def _compute_window(spec, arrays: Dict[str, np.ndarray], n: int) -> np.ndarray:
     acc = np.full(nparts, ident)
     (np.minimum if fn == "min" else np.maximum).at(acc, pid, arg)
     return acc[pid]
+
+
+def _running_window(fn: str, pid: np.ndarray, okeys, arg, n: int) -> np.ndarray:
+    """ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW: sort within
+    partitions by the OVER(ORDER BY) keys and accumulate (running
+    aggregate).  Vectorized via segment-reset cumulative sums."""
+    lex: List[np.ndarray] = [pid]
+    for vals, asc in okeys:
+        a = np.asarray(vals)
+        if a.dtype == object:
+            try:
+                a = a.astype(np.float64)
+            except (ValueError, TypeError):
+                pass
+        if np.issubdtype(a.dtype, np.number):
+            lex.append(a.astype(np.float64) if asc else -a.astype(np.float64))
+        else:
+            _, inv = np.unique(a.astype(str), return_inverse=True)
+            lex.append(inv if asc else -inv)
+    order = np.lexsort(tuple(reversed(lex)))
+    spid = pid[order]
+    starts = np.ones(n, dtype=bool)
+    starts[1:] = spid[1:] != spid[:-1]
+    start_idx = np.maximum.accumulate(np.where(starts, np.arange(n), 0))
+    out_sorted = np.empty(n, dtype=np.float64)
+    if fn == "count":
+        out_sorted = (np.arange(n) - start_idx + 1).astype(np.float64)
+    else:
+        if arg is None:
+            raise ValueError(f"window {fn} needs an argument")
+        v = np.asarray(arg, dtype=np.float64)[order]
+        if fn in ("sum", "avg"):
+            c = np.cumsum(v)
+            base = np.where(start_idx > 0, c[start_idx - 1], 0.0)
+            run = c - base
+            if fn == "sum":
+                out_sorted = run
+            else:
+                out_sorted = run / (np.arange(n) - start_idx + 1)
+        else:  # running min/max: loop with partition resets
+            best = 0.0
+            for i in range(n):
+                best = v[i] if starts[i] else (min(best, v[i]) if fn == "min" else max(best, v[i]))
+                out_sorted[i] = best
+    out = np.empty(n, dtype=np.float64)
+    out[order] = out_sorted
+    return out
 
 
 # ---------------------------------------------------------------------------

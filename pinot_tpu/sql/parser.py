@@ -465,6 +465,7 @@ class _Parser:
                             OrderByExpr(map_expr_columns(o.expr, strip_q), o.ascending, o.nulls_last)
                             for o in s.order_by
                         ),
+                        s.frame,
                     )
                 return map_expr_columns(s, strip_q)
 
@@ -579,11 +580,23 @@ class _Parser:
                     worder.append(OrderByExpr(oe, ascending=asc))
                     if not self.accept_op(","):
                         break
+            frame = "range_all"
+            if self.cur.kind == "ident" and str(self.cur.value).lower() == "rows":
+                # ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW
+                self.advance()
+                for w in ("between", "unbounded", "preceding", "and", "current", "row"):
+                    t = self.cur
+                    if t.kind not in ("ident", "kw") or str(t.value).lower() != w:
+                        self.fail(
+                            "only ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW frames are supported"
+                        )
+                    self.advance()
+                frame = "rows_cumulative"
             self.expect_op(")")
             arg = None
             if e.args and not (e.args[0].is_column and e.args[0].op == "*"):
                 arg = e.args[0]
-            return WindowSpec(e.op, arg, tuple(partition), tuple(worder))
+            return WindowSpec(e.op, arg, tuple(partition), tuple(worder), frame)
         if isinstance(e, Expr) and e.kind.name == "CALL" and is_agg_function(e.op):
             spec = self._call_to_agg(e)
             # FILTER (WHERE ...) clause — Pinot filtered aggregations

@@ -207,3 +207,27 @@ class TestPostAggregation:
         eng, conn = env
         sql = "SELECT dept, SUM(score) FROM t GROUP BY dept ORDER BY SUM(score) * 1.0 / COUNT(*) DESC"
         assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+
+class TestRunningFrames:
+    """ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW (running frames)."""
+
+    def test_running_sum_and_count(self, env):
+        eng, conn = env
+        sql = (
+            "SELECT city, v, "
+            "SUM(v) OVER (PARTITION BY city ORDER BY v ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW), "
+            "COUNT(*) OVER (PARTITION BY city ORDER BY v ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) "
+            "FROM t WHERE v < 150 ORDER BY city, v LIMIT 200"
+        )
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)
+
+    def test_running_min_avg(self, env):
+        eng, conn = env
+        sql = (
+            "SELECT dept, v, "
+            "MIN(score) OVER (PARTITION BY dept ORDER BY v ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW), "
+            "AVG(score) OVER (PARTITION BY dept ORDER BY v ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) "
+            "FROM t WHERE v > 9900 ORDER BY dept, v LIMIT 120"
+        )
+        assert_same_rows(eng.query(sql).rows, conn.execute(sql).fetchall(), ordered=True)

@@ -159,27 +159,70 @@ class DistinctCountThetaFunction(AggFunction):
         kmv = jnp.full((k + 1,), _I64_MAX, dtype=jnp.int64).at[slot].set(s)[:k]
         return {"kmv": kmv}
 
+    GROUPED_K = 256  # per-group sketch width (cell budget bounds it further)
+
     def partial_grouped(self, values, mask, keys, num_groups):
-        raise NotImplementedError(
-            "DISTINCTCOUNTTHETA does not support GROUP BY (per-group K-min sets); "
-            "use DISTINCTCOUNTHLL or exact DISTINCTCOUNT"
+        """Per-group K smallest DISTINCT hashes via one double-keyed sort:
+        rows sort by (group, hash); the distinct-rank within each group
+        comes from cumulative counts with per-group resets, and ranks < K
+        scatter into the [G, K] table (the same static-shape compaction
+        trick as the sparse group-by)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from pinot_tpu.query.sketches import _device_hash32, _device_hash_values
+
+        kk = max(16, min(self.GROUPED_K, 2_000_000 // max(1, num_groups)))
+        _check_cell_budget(self.name, num_groups, kk)
+        h1 = _device_hash_values(values)
+        h2 = _device_hash32(h1 ^ np.uint32(0x9E3779B9))
+        h = ((h1 & np.uint32(0x7FFFFFFF)).astype(jnp.int64) << np.int64(31)) | (
+            h2 >> np.uint32(1)
+        ).astype(jnp.int64)
+        gk = jnp.where(mask, keys.astype(jnp.int32), np.int32(num_groups))
+        h = jnp.where(mask, h, _I64_MAX)
+        s_k, s_h = lax.sort((gk, h), num_keys=2)
+        prev_k = jnp.concatenate([jnp.full((1,), -1, s_k.dtype), s_k[:-1]])
+        prev_h = jnp.concatenate([jnp.full((1,), -1, s_h.dtype), s_h[:-1]])
+        grp_start = s_k != prev_k
+        new = (grp_start | (s_h != prev_h)) & (s_k < num_groups) & (s_h != _I64_MAX)
+        c = jnp.cumsum(new.astype(jnp.int32))
+        base = lax.cummax(jnp.where(grp_start, c - new.astype(jnp.int32), 0))
+        rank = c - 1 - base  # 0-indexed distinct rank within the group
+        cells = num_groups * kk
+        slot = jnp.where(new & (rank < kk), s_k * np.int32(kk) + rank, np.int32(cells))
+        kmv = (
+            jnp.full((cells + 1,), _I64_MAX, dtype=jnp.int64)
+            .at[slot]
+            .set(s_h)[:cells]
+            .reshape(num_groups, kk)
         )
+        return {"kmv": kmv}
 
     def merge(self, a, b):
-        u = np.unique(np.concatenate([np.asarray(a["kmv"]), np.asarray(b["kmv"])]))
-        u = u[u != _I64_MAX][: self.K]
-        if len(u) < self.K:
-            u = np.concatenate([u, np.full(self.K - len(u), _I64_MAX, dtype=np.int64)])
-        return {"kmv": u}
+        """Merge KMV rows along the last axis: concat, sort, mask duplicate
+        neighbors to MAX, re-sort, keep the K smallest (shape-generic:
+        scalar [K] and grouped [G, K])."""
+        x = np.concatenate([np.asarray(a["kmv"]), np.asarray(b["kmv"])], axis=-1)
+        x = np.sort(x, axis=-1)
+        dup = np.zeros_like(x, dtype=bool)
+        dup[..., 1:] = x[..., 1:] == x[..., :-1]
+        x = np.where(dup, _I64_MAX, x)
+        x = np.sort(x, axis=-1)
+        k = min(np.asarray(a["kmv"]).shape[-1], np.asarray(b["kmv"]).shape[-1])
+        return {"kmv": x[..., :k]}
 
     def final(self, p):
         kmv = np.asarray(p["kmv"])
-        valid = kmv[kmv != _I64_MAX]
-        n = len(valid)
-        if n < min(self.K, max(1, len(kmv))):
-            return n  # fewer distincts than K: exact
-        theta = float(valid[-1]) / float(1 << 62)  # kth smallest / max-hash
-        return (n - 1) / theta if theta > 0 else n
+        k = kmv.shape[-1]
+        valid = kmv != _I64_MAX
+        n_v = valid.sum(axis=-1)
+        kth = kmv[..., -1].astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            theta = kth / float(1 << 62)
+            est = np.where(theta > 0, (n_v - 1) / theta, n_v)
+        out = np.where(n_v < k, n_v, est)
+        return out if kmv.ndim > 1 else out.item()
 
     def final_dtype(self):
         return np.dtype(np.int64)

@@ -182,3 +182,37 @@ class TestDistinctSumAvg:
         for row in res2.rows:
             d = np.unique(v[g == int(row[0])])
             assert float(row[1]) == float(d.sum())
+
+
+class TestGroupedTheta:
+    def test_grouped_theta_exact_below_k(self):
+        rng = np.random.default_rng(29)
+        n = 60_000
+        g = rng.integers(0, 5, n)
+        # ~120 distinct values per group << per-group K
+        v = rng.integers(0, 120, n) + g * 1000
+        schema = Schema(
+            "gt",
+            [FieldSpec("g", DataType.INT), FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)],
+        )
+        eng = _make_engine({"g": g, "v": v}, schema)
+        res = eng.query("SELECT g, DISTINCTCOUNTTHETA(v) FROM gt GROUP BY g ORDER BY g")
+        for row in res.rows:
+            expected = len(np.unique(v[g == int(row[0])]))
+            assert int(row[1]) == expected, (row, expected)
+
+    def test_grouped_theta_estimates_above_k(self):
+        rng = np.random.default_rng(31)
+        n = 200_000
+        g = rng.integers(0, 4, n)
+        v = rng.integers(0, 5000, n) + g * 100_000  # ~5000 distinct per group > K=256
+        schema = Schema(
+            "gt2",
+            [FieldSpec("g", DataType.INT), FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)],
+        )
+        eng = _make_engine({"g": g, "v": v}, schema, n_segments=3)
+        res = eng.query("SELECT g, DISTINCTCOUNTTHETA(v) FROM gt2 GROUP BY g ORDER BY g")
+        for row in res.rows:
+            true = len(np.unique(v[g == int(row[0])]))
+            rel = abs(float(row[1]) - true) / true
+            assert rel < 0.15, (row, true, rel)  # K=256 -> ~6% typical error

@@ -154,7 +154,10 @@ class DistinctCountThetaFunction(AggFunction):
         prev = jnp.concatenate([jnp.full((1,), -1, s.dtype), s[:-1]])
         is_new = (s != prev) & (s != _I64_MAX)
         idx = jnp.cumsum(is_new.astype(jnp.int32)) - 1
-        k = min(self.K, s.shape[0])
+        # ALWAYS full width: a short sketch would cap the whole query's
+        # accuracy at merge time (review-caught); segments with fewer rows
+        # than K pad with the sentinel and stay exact
+        k = self.K
         slot = jnp.where(is_new & (idx < k), idx, k)
         kmv = jnp.full((k + 1,), _I64_MAX, dtype=jnp.int64).at[slot].set(s)[:k]
         return {"kmv": kmv}

@@ -283,7 +283,7 @@ class FilterCompiler:
         col = self.segment.column(name)
         if not col.has_dictionary:
             raise ValueError(f"{p.lhs.op} predicate requires dictionary column, {name} is raw")
-        derived = scalar.eval_dict_fn(p.lhs, col.dictionary.values)
+        derived = scalar.derived_for(p.lhs, col.dictionary)
         table = _match_values(p, derived)
         has_nulls = col.nulls is not None and self.null_handling
         key = self._key("dtable")

@@ -383,7 +383,7 @@ def _group_dim(expr: Expr, segment: ImmutableSegment, null_handling: bool) -> Gr
         col = next(a for a in expr.args if not a.is_literal).op
         c = segment.column(col)
         if c.has_dictionary:
-            derived = scalar.eval_dict_fn(expr, c.dictionary.values)
+            derived = scalar.derived_for(expr, c.dictionary)
             uniq, remap = np.unique(derived, return_inverse=True)
             return GroupDim(
                 expr,

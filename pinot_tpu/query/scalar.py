@@ -393,9 +393,14 @@ DICT_FNS["json_extract_scalar"] = _json_extract
 
 def _java_fmt_to_strptime(fmt: str) -> str:
     """Joda/SimpleDateFormat pattern -> strptime (the subset Pinot docs use:
-    yyyy MM dd HH mm ss SSS)."""
+    yyyy MM dd HH mm ss SSS, plus 'quoted' literal sections like 'T')."""
+    import re as _re
+
     out = fmt
+    # SSS first: translating ss earlier would leave %S adjacent to SSS and
+    # corrupt the pattern (ssSSS -> %SSSS mis-splits)
     for a, b in (
+        ("SSS", "%f"),  # strptime %f = microseconds; see callers
         ("yyyy", "%Y"),
         ("MM", "%m"),
         ("dd", "%d"),
@@ -404,7 +409,8 @@ def _java_fmt_to_strptime(fmt: str) -> str:
         ("ss", "%S"),
     ):
         out = out.replace(a, b)
-    return out.replace("SSS", "%f")  # strptime %f = microseconds; see below
+    # SimpleDateFormat quotes literal text: yyyy-MM-dd'T'HH:mm:ss
+    return _re.sub(r"'([^']*)'", r"\1", out)
 
 
 def _from_datetime(values: np.ndarray, fmt: str) -> np.ndarray:
@@ -442,11 +448,10 @@ def to_datetime(ms, fmt: str):
     out = np.empty(len(ms), dtype=object)
     for i, v in enumerate(np.asarray(ms)):
         d = _dt.datetime.fromtimestamp(int(v) / 1000, tz=_dt.timezone.utc)
-        s = d.strftime(py_fmt)
-        if "%f" in py_fmt:
-            # strftime %f gives microseconds; SSS wants milliseconds
-            s = s.replace(d.strftime("%f"), d.strftime("%f")[:3])
-        out[i] = s
+        # SSS = milliseconds: substitute into the FORMAT (a post-hoc string
+        # replace corrupted outputs whose digits matched — review-caught)
+        fmt_i = py_fmt.replace("%f", f"{d.microsecond // 1000:03d}")
+        out[i] = d.strftime(fmt_i)
     return out
 
 STRING_RESULT_DICT_FNS = frozenset(
@@ -514,6 +519,26 @@ def eval_dict_fn(expr, values: np.ndarray) -> np.ndarray:
     """Apply a dict-domain function to a dictionary's values array."""
     lits = [a.value for a in expr.args if a.is_literal]
     return DICT_FNS[expr.op](values, *lits)
+
+
+# derived arrays keyed by (expr fingerprint, dictionary fingerprint) — the
+# planner's interval bound and the execution gathers would otherwise run
+# the same O(cardinality) pass (per-entry strptime for FROMDATETIME) two or
+# three times per plan (review-caught)
+_DERIVED_CACHE: Dict[Any, np.ndarray] = {}
+_DERIVED_CACHE_MAX = 256
+
+
+def derived_for(expr, dictionary) -> np.ndarray:
+    key = (expr.fingerprint(), dictionary.fingerprint())
+    hit = _DERIVED_CACHE.get(key)
+    if hit is not None:
+        return hit
+    out = eval_dict_fn(expr, dictionary.values)
+    if len(_DERIVED_CACHE) >= _DERIVED_CACHE_MAX:
+        _DERIVED_CACHE.pop(next(iter(_DERIVED_CACHE)))
+    _DERIVED_CACHE[key] = out
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -595,9 +620,17 @@ def expr_int_range(expr, segment) -> Optional[Tuple[int, int]]:
         col = next(a for a in expr.args if not a.is_literal).op
         c = segment.column(col)
         if c.has_dictionary and c.dictionary.cardinality:
-            derived = eval_dict_fn(expr, c.dictionary.values)
-            if np.issubdtype(np.asarray(derived).dtype, np.integer):
-                return (int(derived.min()), int(derived.max()))
+            derived = derived_for(expr, c.dictionary)
+            a = np.asarray(derived)
+            if np.issubdtype(a.dtype, np.integer):
+                # FROMDATETIME marks unparseable values with int64-min —
+                # keeping it in the bound explodes the key space to 2^63
+                # (review-caught); such rows fall outside the dense table
+                # and silently drop from expression group-bys (documented)
+                ok = a != np.iinfo(np.int64).min
+                if not ok.any():
+                    return None
+                return (int(a[ok].min()), int(a[ok].max()))
         return None
     if op in ("plus", "add", "minus", "sub", "times", "mult") and len(expr.args) == 2:
         ra = expr_int_range(expr.args[0], segment)

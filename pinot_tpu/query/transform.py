@@ -157,7 +157,7 @@ def eval_expr(expr: Expr, segment: ImmutableSegment, cols: Dict) -> EvalResult:
                 f"string-valued {op}(...) never materializes on device; use it in "
                 "predicates, GROUP BY, or the select list (host paths)"
             )
-        derived = scalar.eval_dict_fn(expr, c.dictionary.values)
+        derived = scalar.derived_for(expr, c.dictionary)
         entry = cols[col]
         vals = jnp.asarray(derived)[entry["codes"].astype(jnp.int32)]
         return vals, entry.get("nulls")
@@ -314,7 +314,7 @@ def eval_expr_host(expr: Expr, segment: ImmutableSegment, docids: np.ndarray) ->
         col = next(a for a in expr.args if not a.is_literal).op
         c = segment.column(col)
         if c.has_dictionary:
-            derived = scalar.eval_dict_fn(expr, c.dictionary.values)
+            derived = scalar.derived_for(expr, c.dictionary)
             return derived[np.asarray(c.codes, dtype=np.int64)[docids]]
     op = expr.op
     if op in _BINARY and len(expr.args) == 2:

@@ -216,3 +216,16 @@ class TestGroupedTheta:
             true = len(np.unique(v[g == int(row[0])]))
             rel = abs(float(row[1]) - true) / true
             assert rel < 0.15, (row, true, rel)  # K=256 -> ~6% typical error
+
+    def test_small_segment_does_not_cap_accuracy(self):
+        """A tiny segment must not shrink the merged sketch width
+        (review-caught: exactness below K has to survive the union)."""
+        rng = np.random.default_rng(41)
+        v = np.concatenate([rng.integers(0, 200, 30), rng.integers(100, 400, 50_000)])
+        schema = Schema("tt", [FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)])
+        eng = QueryEngine()
+        eng.register_table(schema)
+        eng.add_segment("tt", build_segment(schema, {"v": v[:30]}, "tiny"))
+        eng.add_segment("tt", build_segment(schema, {"v": v[30:]}, "big"))
+        got = int(eng.query("SELECT DISTINCTCOUNTTHETA(v) FROM tt").rows[0][0])
+        assert got == len(np.unique(v))  # still exact: union << K=4096

@@ -299,3 +299,16 @@ class TestSdfDatetime:
         for row in res.rows:
             d = dt2.datetime.fromtimestamp(row[0] / 1000, tz=dt2.timezone.utc)
             assert row[1] == d.strftime("%Y-%m-%d %H:%M:%S")
+
+    def test_quoted_literal_format_and_millis(self):
+        """'T' quoted literal + SSS millis round-trip (review-caught)."""
+        from pinot_tpu.query import scalar as sc
+
+        got = sc.to_datetime(np.array([0]), "HHmmssSSS")
+        assert got[0] == "000000000"
+        parsed = sc._from_datetime(
+            np.array(["2024-03-05T06:07:08"], dtype=object), "yyyy-MM-dd'T'HH:mm:ss"
+        )
+        import datetime as dt2
+
+        assert parsed[0] == int(dt2.datetime(2024, 3, 5, 6, 7, 8, tzinfo=dt2.timezone.utc).timestamp() * 1000)

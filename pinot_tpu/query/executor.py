@@ -314,6 +314,11 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
             out_keys.append(e.op)
             arrays[e.op] = _decoded(e.op)
             continue
+        if e.kind.name == "CALL" and e.op == "unnest":
+            key = f"__sel{i}"
+            out_keys.append(key)
+            arrays[key] = np.zeros(len(docids), dtype=object)  # filled by the explode below
+            continue
         # expression select item: host evaluation over the gathered rows only
         # (O(limit), TransformOperator-on-selection analog)
         key = f"__sel{i}"
@@ -336,6 +341,35 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
         arrays[f"__ord{i}"] = _value_array(ob.expr)
     cols = out_keys + [f"__ord{i}" for i in range(len(ctx.order_by))]
     cols += sorted(k for k in arrays if k.startswith("__wx_"))
+
+    # UNNEST(mvcol): explode each gathered row once per element (the MSE
+    # UnnestOperator analog on the selection path; zero-length rows drop)
+    unnest_keys = [
+        (k, e)
+        for k, e in zip(out_keys, items)
+        if isinstance(e, planner.Expr) and e.kind.name == "CALL" and e.op == "unnest"
+    ]
+    if unnest_keys:
+        if len(unnest_keys) > 1:
+            raise NotImplementedError("one UNNEST per query")
+        ukey, uexpr = unnest_keys[0]
+        if not (len(uexpr.args) == 1 and uexpr.args[0].is_column):
+            raise NotImplementedError("UNNEST takes a bare multi-value column")
+        c = segment.column(uexpr.args[0].op)
+        if c.mv_lengths is None:
+            raise ValueError(f"UNNEST requires a multi-value column ({uexpr.args[0].op})")
+        reps = c.mv_lengths[docids].astype(np.int64)
+        idx = np.repeat(np.arange(len(docids)), reps)
+        elems = np.concatenate(
+            [list(t) for t in c.decoded()[docids] if len(t)] or [np.array([], dtype=object)]
+        )
+        new_arrays: Dict[str, np.ndarray] = {}
+        for k in cols:
+            if k == ukey:
+                new_arrays[k] = np.asarray(elems, dtype=object)
+            else:
+                new_arrays[k] = np.asarray(arrays[k], dtype=object)[idx]
+        arrays = new_arrays
     return SelectionSegmentResult(columns=cols, arrays=arrays)
 
 

@@ -168,3 +168,19 @@ class TestArrayLength:
                     expected[t] += 1
         got = {r[0]: int(r[1]) for r in res.rows}
         assert got == dict(expected)
+
+
+class TestUnnest:
+    def test_unnest_explodes_elements(self, eng, data):
+        res = eng.query("SELECT city, UNNEST(tags) FROM mv WHERE v > 90 LIMIT 100000")
+        expected = []
+        for c, t_list, v in zip(data["city"], data["tags"], data["v"]):
+            if v > 90:
+                for t in t_list:
+                    expected.append((c, t))
+        assert sorted(map(tuple, res.rows)) == sorted(expected)
+
+    def test_unnest_drops_empty_rows(self, eng, data):
+        res = eng.query("SELECT UNNEST(scores) FROM mv LIMIT 1000000")
+        expected = sorted(x for s in data["scores"] for x in s)
+        assert sorted(r[0] for r in res.rows) == expected

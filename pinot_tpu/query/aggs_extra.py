@@ -123,6 +123,13 @@ class PercentileLogSketchFunction(AggFunction):
 # DISTINCTCOUNTTHETA: KMV sketch (K smallest distinct hashes)
 # ---------------------------------------------------------------------------
 class DistinctCountThetaFunction(AggFunction):
+    """KMV theta sketch, optionally with SUB-FILTER set expressions
+    (reference: DistinctCountThetaSketchAggregationFunction's
+    'filter1', ..., 'SET_INTERSECT($1, $2)' literal arguments): each filter
+    string compiles through the ordinary FilterCompiler, the kernel builds
+    one KMV row per filter, and the final step evaluates the set expression
+    over (hash set, theta) pairs host-side."""
+
     name = "distinctcounttheta"
     needs_codes = True
     needs_binding = True
@@ -133,10 +140,37 @@ class DistinctCountThetaFunction(AggFunction):
 
     K = 4096
 
+    def __init__(self, filter_exprs: Tuple[str, ...] = (), post_expr: Optional[str] = None):
+        self.filter_exprs = tuple(filter_exprs)
+        self.post_expr = post_expr
+
+    @property
+    def subfilter_args(self) -> bool:
+        return bool(self.filter_exprs)
+
+    def with_args(self, literal_args):
+        if not literal_args:
+            return self
+        lits = [str(a) for a in literal_args]
+        # last literal = set expression when it references $i sketches
+        if "$" in lits[-1]:
+            return DistinctCountThetaFunction(tuple(lits[:-1]), lits[-1])
+        return DistinctCountThetaFunction(tuple(lits), None)
+
     def bind_column(self, info: ColumnBinding) -> "DistinctCountThetaFunction":
         return self  # hash-based: no per-column constants
 
     def partial(self, values, mask):
+        import jax.numpy as jnp
+
+        if self.filter_exprs:
+            # values = (raw values, subfilter_mask_1, ..., subfilter_mask_F)
+            v, *fmasks = values
+            rows = [self._one_sketch(v, mask & fm) for fm in fmasks]
+            return {"kmv": jnp.stack(rows, axis=0)}  # [F, K]
+        return {"kmv": self._one_sketch(values, mask)}
+
+    def _one_sketch(self, values, mask):
         import jax.numpy as jnp
         from jax import lax
 
@@ -159,8 +193,7 @@ class DistinctCountThetaFunction(AggFunction):
         # than K pad with the sentinel and stay exact
         k = self.K
         slot = jnp.where(is_new & (idx < k), idx, k)
-        kmv = jnp.full((k + 1,), _I64_MAX, dtype=jnp.int64).at[slot].set(s)[:k]
-        return {"kmv": kmv}
+        return jnp.full((k + 1,), _I64_MAX, dtype=jnp.int64).at[slot].set(s)[:k]
 
     GROUPED_K = 256  # per-group sketch width (cell budget bounds it further)
 
@@ -175,6 +208,8 @@ class DistinctCountThetaFunction(AggFunction):
 
         from pinot_tpu.query.sketches import _device_hash32, _device_hash_values
 
+        if self.filter_exprs:
+            raise NotImplementedError("theta sub-filter set expressions do not support GROUP BY")
         kk = max(16, min(self.GROUPED_K, 2_000_000 // max(1, num_groups)))
         _check_cell_budget(self.name, num_groups, kk)
         h1 = _device_hash_values(values)
@@ -217,6 +252,11 @@ class DistinctCountThetaFunction(AggFunction):
 
     def final(self, p):
         kmv = np.asarray(p["kmv"])
+        if self.post_expr is not None and kmv.ndim == 2:
+            # kmv rows are per-subfilter sketches; evaluate the set expression
+            sets = [self._as_set(kmv[i]) for i in range(kmv.shape[0])]
+            hashes, theta = _eval_theta_set_expr(self.post_expr, sets)
+            return len(hashes) / theta if theta > 0 else 0.0
         k = kmv.shape[-1]
         valid = kmv != _I64_MAX
         n_v = valid.sum(axis=-1)
@@ -227,8 +267,64 @@ class DistinctCountThetaFunction(AggFunction):
         out = np.where(n_v < k, n_v, est)
         return out if kmv.ndim > 1 else out.item()
 
+    @staticmethod
+    def _as_set(row: np.ndarray):
+        """KMV row -> (sorted hash array, theta in (0, 1])."""
+        valid = row[row != _I64_MAX]
+        if len(valid) < len(row):
+            return valid, 1.0  # unsaturated: the COMPLETE distinct hash set
+        return valid, float(valid[-1]) / float(1 << 62)
+
     def final_dtype(self):
         return np.dtype(np.int64)
+
+
+def _eval_theta_set_expr(expr: str, sets):
+    """Evaluate SET_UNION/SET_INTERSECT/SET_DIFF over $i sketch refs.
+
+    Each operand is (sorted distinct hashes, theta).  Standard theta-sketch
+    set algebra: results truncate at theta = min of operand thetas; the
+    estimate is |hashes below theta| / theta."""
+    import re as _re
+
+    s = expr.strip()
+    m = _re.fullmatch(r"\$(\d+)", s)
+    if m:
+        i = int(m.group(1)) - 1
+        if not 0 <= i < len(sets):
+            raise ValueError(f"theta set expression references ${i + 1}; only {len(sets)} filters")
+        return sets[i]
+    m = _re.fullmatch(r"(SET_UNION|SET_INTERSECT|SET_DIFF)\s*\((.*)\)", s, _re.IGNORECASE | _re.DOTALL)
+    if not m:
+        raise ValueError(f"unsupported theta set expression {expr!r}")
+    op = m.group(1).upper()
+    # split args at top-level commas
+    args, depth, start = [], 0, 0
+    body = m.group(2)
+    for j, ch in enumerate(body):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            args.append(body[start:j])
+            start = j + 1
+    args.append(body[start:])
+    operands = [_eval_theta_set_expr(a, sets) for a in args]
+    theta = min(t for _, t in operands)
+    cut = int(theta * float(1 << 62))
+    trimmed = [h[h <= cut] for h, _ in operands]
+    if op == "SET_UNION":
+        out = np.unique(np.concatenate(trimmed))
+    elif op == "SET_INTERSECT":
+        out = trimmed[0]
+        for h in trimmed[1:]:
+            out = out[np.isin(out, h)]
+    else:  # SET_DIFF(a, b)
+        if len(trimmed) != 2:
+            raise ValueError("SET_DIFF takes exactly two operands")
+        out = trimmed[0][~np.isin(trimmed[0], trimmed[1])]
+    return out, theta
 
 
 # ---------------------------------------------------------------------------

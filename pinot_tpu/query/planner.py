@@ -297,6 +297,12 @@ def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
                 cols.extend(ex.columns())
             if s.filter:
                 cols.extend(s.filter.columns())
+            fn_ = for_spec(s)
+            if getattr(fn_, "subfilter_args", False):
+                from pinot_tpu.sql.parser import parse_filter_expression
+
+                for fs in fn_.filter_exprs:
+                    cols.extend(parse_filter_expression(fs).columns())
         elif isinstance(s, WindowSpec):
             if s.expr is not None:
                 cols.extend(s.expr.columns())
@@ -755,6 +761,19 @@ def _build_plan(
     for spec in agg_specs:
         agg_filter_fns.append(fc.compile(spec.filter) if spec.filter is not None else None)
 
+    # theta sub-filter strings ('dim=''a''' literals) compile through the
+    # same FilterCompiler; the kernel feeds one mask per sub-filter
+    agg_subfilter_fns: List[Optional[List[Callable]]] = []
+    for fn_ in aggs:
+        if getattr(fn_, "subfilter_args", False):
+            from pinot_tpu.sql.parser import parse_filter_expression
+
+            agg_subfilter_fns.append(
+                [fc.compile(parse_filter_expression(s)) for s in fn_.filter_exprs]
+            )
+        else:
+            agg_subfilter_fns.append(None)
+
     # Columns touched ONLY by index-resolved predicates never ship to device
     # (the index row already answered them) — the byte-savings half of the
     # BitmapBasedFilterOperator redesign.
@@ -781,7 +800,7 @@ def _build_plan(
     def _agg_inputs(cols, params, base_mask):
         """Per-aggregation (values, mask) with null + FILTER handling."""
         out = []
-        for spec, fn, ffn in zip(agg_specs, aggs, agg_filter_fns):
+        for spec, fn, ffn, sfns in zip(agg_specs, aggs, agg_filter_fns, agg_subfilter_fns):
             mask = base_mask
             if ffn is not None:
                 ft, _ = ffn(cols, params)
@@ -812,6 +831,8 @@ def _build_plan(
                     if en is not None and null_handling:
                         mask = mask & ~en
                 vals = (vals, *extras)
+            if sfns:
+                vals = (vals, *[mask & sf(cols, params)[0] for sf in sfns])
             out.append((vals, mask))
         return out
 

@@ -906,6 +906,16 @@ class _Parser:
         self.fail("expected integer literal")
 
 
+def parse_filter_expression(text: str) -> FilterNode:
+    """Parse a standalone boolean expression (theta sub-filter strings,
+    DISTINCTCOUNTTHETA(col, 'dim=''a''', ...))."""
+    p = _Parser(text)
+    node = p.boolean_expr()
+    if p.cur.kind != "eof":
+        p.fail("unexpected trailing input in filter expression")
+    return node
+
+
 def parse_query(sql: str) -> QueryContext:
     """Parse one SQL statement into a QueryContext (CalciteSqlParser analog)."""
     return _Parser(sql).parse()

@@ -229,3 +229,48 @@ class TestGroupedTheta:
         eng.add_segment("tt", build_segment(schema, {"v": v[30:]}, "big"))
         got = int(eng.query("SELECT DISTINCTCOUNTTHETA(v) FROM tt").rows[0][0])
         assert got == len(np.unique(v))  # still exact: union << K=4096
+
+
+class TestThetaSetExpressions:
+    def test_intersect_union_diff(self):
+        rng = np.random.default_rng(43)
+        n = 40_000
+        user = rng.integers(0, 800, n)
+        dim = rng.choice(["a", "b", "c"], n)
+        schema = Schema(
+            "ts",
+            [FieldSpec("dim", DataType.STRING), FieldSpec("user", DataType.LONG, role=FieldRole.METRIC)],
+        )
+        eng = _make_engine({"dim": dim.astype(object), "user": user}, schema)
+        ua = set(user[dim == "a"].tolist())
+        ub = set(user[dim == "b"].tolist())
+        q = (
+            "SELECT DISTINCTCOUNTTHETA(user, 'dim = ''a''', 'dim = ''b''', '{expr}') FROM ts"
+        )
+        got_i = int(eng.query(q.format(expr="SET_INTERSECT($1, $2)")).rows[0][0])
+        assert got_i == len(ua & ub)  # < K -> exact
+        got_u = int(eng.query(q.format(expr="SET_UNION($1, $2)")).rows[0][0])
+        assert got_u == len(ua | ub)
+        got_d = int(eng.query(q.format(expr="SET_DIFF($1, $2)")).rows[0][0])
+        assert got_d == len(ua - ub)
+
+    def test_nested_set_expression(self):
+        rng = np.random.default_rng(47)
+        n = 30_000
+        user = rng.integers(0, 500, n)
+        dim = rng.choice(["a", "b", "c"], n)
+        schema = Schema(
+            "ts2",
+            [FieldSpec("dim", DataType.STRING), FieldSpec("user", DataType.LONG, role=FieldRole.METRIC)],
+        )
+        eng = _make_engine({"dim": dim.astype(object), "user": user}, schema)
+        ua = set(user[dim == "a"].tolist())
+        ub = set(user[dim == "b"].tolist())
+        uc = set(user[dim == "c"].tolist())
+        got = int(
+            eng.query(
+                "SELECT DISTINCTCOUNTTHETA(user, 'dim = ''a''', 'dim = ''b''', 'dim = ''c''', "
+                "'SET_INTERSECT(SET_UNION($1, $2), $3)') FROM ts2"
+            ).rows[0][0]
+        )
+        assert got == len((ua | ub) & uc)

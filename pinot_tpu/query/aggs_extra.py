@@ -143,19 +143,50 @@ class DistinctCountThetaFunction(AggFunction):
     def __init__(self, filter_exprs: Tuple[str, ...] = (), post_expr: Optional[str] = None):
         self.filter_exprs = tuple(filter_exprs)
         self.post_expr = post_expr
+        # parsed once here; planner column-collection and compilation reuse
+        # these nodes instead of re-parsing the strings per segment plan
+        if filter_exprs:
+            from pinot_tpu.sql.parser import parse_filter_expression
+
+            self.filter_nodes = tuple(parse_filter_expression(s) for s in self.filter_exprs)
+        else:
+            self.filter_nodes = ()
 
     @property
     def subfilter_args(self) -> bool:
         return bool(self.filter_exprs)
 
+    _SET_EXPR_RX = None  # compiled lazily below
+
+    @classmethod
+    def _is_set_expr(cls, s: str) -> bool:
+        import re as _re
+
+        if cls._SET_EXPR_RX is None:
+            cls._SET_EXPR_RX = _re.compile(
+                r"^\s*(?:\$\d+|(?:SET_UNION|SET_INTERSECT|SET_DIFF)\s*\()", _re.IGNORECASE
+            )
+        return bool(cls._SET_EXPR_RX.match(s))
+
     def with_args(self, literal_args):
         if not literal_args:
             return self
         lits = [str(a) for a in literal_args]
-        # last literal = set expression when it references $i sketches
-        if "$" in lits[-1]:
-            return DistinctCountThetaFunction(tuple(lits[:-1]), lits[-1])
-        return DistinctCountThetaFunction(tuple(lits), None)
+        # the set expression is recognized by SHAPE ($i / SET_* call), not by
+        # containing '$' (review-caught: a filter like dim='a$b' was eaten)
+        if self._is_set_expr(lits[-1]):
+            filters, post = tuple(lits[:-1]), lits[-1]
+            if not filters:
+                raise ValueError("theta set expression given without any sub-filters")
+        else:
+            filters, post = tuple(lits), None
+        if filters and post is None:
+            if len(filters) > 1:
+                raise ValueError(
+                    "multiple theta sub-filters need a set expression (e.g. 'SET_INTERSECT($1, $2)')"
+                )
+            post = "$1"  # single filter: the sketch of the filtered rows
+        return DistinctCountThetaFunction(filters, post)
 
     def bind_column(self, info: ColumnBinding) -> "DistinctCountThetaFunction":
         return self  # hash-based: no per-column constants
@@ -269,11 +300,13 @@ class DistinctCountThetaFunction(AggFunction):
 
     @staticmethod
     def _as_set(row: np.ndarray):
-        """KMV row -> (sorted hash array, theta in (0, 1])."""
+        """KMV row -> (hashes STRICTLY below theta, theta in (0, 1]).
+        Saturated sketches drop the theta-defining Kth hash so estimates
+        match the plain path's (K-1)/theta (review-caught bias)."""
         valid = row[row != _I64_MAX]
         if len(valid) < len(row):
             return valid, 1.0  # unsaturated: the COMPLETE distinct hash set
-        return valid, float(valid[-1]) / float(1 << 62)
+        return valid[:-1], float(valid[-1]) / float(1 << 62)
 
     def final_dtype(self):
         return np.dtype(np.int64)
@@ -313,7 +346,9 @@ def _eval_theta_set_expr(expr: str, sets):
     operands = [_eval_theta_set_expr(a, sets) for a in args]
     theta = min(t for _, t in operands)
     cut = int(theta * float(1 << 62))
-    trimmed = [h[h <= cut] for h, _ in operands]
+    # hashes STRICTLY below theta participate (theta-sketch convention);
+    # theta == 1.0 means every operand is a complete set — keep everything
+    trimmed = [h[h < cut] if theta < 1.0 else h for h, _ in operands]
     if op == "SET_UNION":
         out = np.unique(np.concatenate(trimmed))
     elif op == "SET_INTERSECT":

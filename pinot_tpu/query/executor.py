@@ -18,7 +18,7 @@ import numpy as np
 
 from pinot_tpu.query import planner
 from pinot_tpu.query.functions import FIELD_COMBINE, field_identity
-from pinot_tpu.query.ir import FilterNode, FilterOp, PredicateType, QueryContext
+from pinot_tpu.query.ir import Expr, FilterNode, FilterOp, PredicateType, QueryContext
 from pinot_tpu.query.transform import eval_expr_host
 from pinot_tpu.query.result import (
     AggSegmentResult,
@@ -246,12 +246,16 @@ def _gather_selection(ctx: QueryContext, plan, segment: ImmutableSegment, tmask:
     from pinot_tpu.query.ir import WindowSpec
 
     docids = np.nonzero(tmask)[0]
-    # window functions rank/aggregate over ALL matched rows — per-segment
-    # trim would change results, so it is disabled (bounded by a valve)
-    if ctx.windows:
+    # window functions rank/aggregate over ALL matched rows, and UNNEST
+    # drops empty-MV rows AFTER gathering — per-segment trim would change
+    # results for both, so it is disabled (bounded by a valve)
+    has_unnest = any(
+        isinstance(s, Expr) and s.kind.name == "CALL" and s.op == "unnest" for s in ctx.select_list
+    )
+    if ctx.windows or has_unnest:
         cap = int(ctx.options.get("maxWindowRows", 1_000_000))
         if len(docids) > cap:
-            raise ValueError(f"window query matched {len(docids)} rows > maxWindowRows={cap}")
+            raise ValueError(f"window/unnest query matched {len(docids)} rows > maxWindowRows={cap}")
         want = len(docids)
     else:
         want = ctx.offset + ctx.limit

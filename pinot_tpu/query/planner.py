@@ -299,10 +299,8 @@ def _needed_columns(ctx: QueryContext, segment: ImmutableSegment) -> List[str]:
                 cols.extend(s.filter.columns())
             fn_ = for_spec(s)
             if getattr(fn_, "subfilter_args", False):
-                from pinot_tpu.sql.parser import parse_filter_expression
-
-                for fs in fn_.filter_exprs:
-                    cols.extend(parse_filter_expression(fs).columns())
+                for node in fn_.filter_nodes:
+                    cols.extend(node.columns())
         elif isinstance(s, WindowSpec):
             if s.expr is not None:
                 cols.extend(s.expr.columns())
@@ -766,11 +764,7 @@ def _build_plan(
     agg_subfilter_fns: List[Optional[List[Callable]]] = []
     for fn_ in aggs:
         if getattr(fn_, "subfilter_args", False):
-            from pinot_tpu.sql.parser import parse_filter_expression
-
-            agg_subfilter_fns.append(
-                [fc.compile(parse_filter_expression(s)) for s in fn_.filter_exprs]
-            )
+            agg_subfilter_fns.append([fc.compile(node) for node in fn_.filter_nodes])
         else:
             agg_subfilter_fns.append(None)
 

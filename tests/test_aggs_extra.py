@@ -274,3 +274,17 @@ class TestThetaSetExpressions:
             ).rows[0][0]
         )
         assert got == len((ua | ub) & uc)
+
+    def test_single_filter_and_dollar_in_literal(self):
+        """Review regressions: one sub-filter returns a scalar count, and a
+        '$' inside a filter literal is NOT mistaken for a set expression."""
+        rng = np.random.default_rng(53)
+        dim = rng.choice(["a$b", "c"], 2000)
+        user = rng.integers(0, 300, 2000)
+        schema = Schema(
+            "tdollar",
+            [FieldSpec("dim", DataType.STRING), FieldSpec("user", DataType.LONG, role=FieldRole.METRIC)],
+        )
+        eng = _make_engine({"dim": dim.astype(object), "user": user}, schema)
+        got = eng.query("SELECT DISTINCTCOUNTTHETA(user, 'dim = ''a$b''') FROM tdollar").rows[0][0]
+        assert int(got) == len(set(user[dim == "a$b"].tolist()))

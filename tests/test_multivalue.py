@@ -184,3 +184,9 @@ class TestUnnest:
         res = eng.query("SELECT UNNEST(scores) FROM mv LIMIT 1000000")
         expected = sorted(x for s in data["scores"] for x in s)
         assert sorted(r[0] for r in res.rows) == expected
+
+    def test_unnest_limit_after_explode(self, eng, data):
+        """Empty-MV rows must not consume LIMIT slots (review-caught:
+        the explode runs over all matched rows, the trim at reduce)."""
+        res = eng.query("SELECT UNNEST(tags) FROM mv LIMIT 7")
+        assert len(res.rows) == 7

@@ -56,12 +56,16 @@ def _psum_field(name: str, x, axis: str):
 
 
 def flatten_cols(cols):
-    """[S, D] shard-local row arrays -> flat [S*D] views (device-side)."""
+    """[S, D, ...] shard-local row arrays -> flat [S*D, ...] views.
+    MV code matrices keep their trailing element axis."""
     out = {}
     for name, entry in cols.items():
         e = {}
         for k, v in entry.items():
-            e[k] = v.reshape(-1) if k in ("codes", "values", "nulls") else v
+            if k in ("codes", "values", "nulls", "lengths"):
+                e[k] = v.reshape((-1,) + v.shape[2:])
+            else:
+                e[k] = v
         out[name] = e
     return out
 
@@ -81,9 +85,8 @@ def make_agg_inputs(agg_specs, aggs, agg_filter_fns, view, table_like, null_hand
                 ft, _ = ffn(cols, params)
                 mask = mask & ft
             if getattr(fn, "mv_input", False):
-                raise NotImplementedError(
-                    "MV aggregations are not yet supported on the distributed stacked path"
-                )
+                out.append(planner_mod.mv_agg_input(spec, fn, view, cols, mask))
+                continue
             if spec.expr is None:
                 vals = mask
             elif fn.needs_codes:
@@ -356,7 +359,14 @@ class DistributedEngine:
         def _col_specs(cols):
             out = {}
             for name, entry in cols.items():
-                out[name] = {k: (P(axis, None) if k in ("codes", "values", "nulls") else P()) for k in entry}
+                out[name] = {
+                    k: (
+                        P(axis, *([None] * (v.ndim - 1)))
+                        if k in ("codes", "values", "nulls", "lengths")
+                        else P()
+                    )
+                    for k, v in entry.items()
+                }
             return out
 
         select_columns: List[str] = []

@@ -32,10 +32,17 @@ class StackedColumn:
     name: str
     data_type: DataType
     dictionary: Optional[Dictionary]  # GLOBAL dictionary (shared key space)
-    codes: Optional[np.ndarray]  # [S, D] unsigned codes when dict-encoded
+    codes: Optional[np.ndarray]  # [S, D] unsigned codes (MV: [S, D, max_len])
     values: Optional[np.ndarray]  # [S, D] raw numerics otherwise
     nulls: Optional[np.ndarray]  # [S, D] bool, None if no nulls
     stats: ColumnStats
+    # multi-value: [S, D] per-row element counts; padded cells hold the
+    # padding code (== cardinality), mirroring segment/builder MV layout
+    mv_lengths: Optional[np.ndarray] = None
+
+    @property
+    def is_multi_value(self) -> bool:
+        return self.mv_lengths is not None
 
     @property
     def has_dictionary(self) -> bool:
@@ -44,6 +51,30 @@ class StackedColumn:
     @property
     def cardinality(self) -> int:
         return self.dictionary.cardinality if self.dictionary else self.stats.cardinality
+
+
+def _stack_mv_column(f, raw, n: int, num_shards: int, D: int) -> "StackedColumn":
+    """MV column -> [S, D, max_len] padded code matrix + [S, D] lengths
+    (distributed twin of segment/builder._build_mv_column)."""
+    from pinot_tpu.segment.builder import _build_mv_column
+
+    col = _build_mv_column(f, np.asarray([tuple(v) if v is not None else () for v in raw], dtype=object), n)
+    total = num_shards * D
+    max_len = col.codes.shape[1]
+    codes = np.full((total, max_len), col.dictionary.cardinality, dtype=col.codes.dtype)
+    codes[:n] = col.codes
+    lengths = np.zeros(total, dtype=np.int32)
+    lengths[:n] = col.mv_lengths
+    return StackedColumn(
+        f.name,
+        f.data_type,
+        col.dictionary,
+        codes.reshape(num_shards, D, max_len),
+        None,
+        None,
+        col.stats,
+        mv_lengths=lengths.reshape(num_shards, D),
+    )
 
 
 _BUILD_COUNTER = 0
@@ -160,6 +191,9 @@ class StackedTable:
         columns: Dict[str, StackedColumn] = {}
         indexes: Dict[str, Dict[str, Any]] = {}
         for f in schema.fields:
+            if not f.single_value:
+                columns[f.name] = _stack_mv_column(f, data[f.name], n, num_shards, D)
+                continue
             arr, nmask = _extract_nulls(f, data[f.name])
             no_dict_cfg = tuple(idx_cfg.no_dictionary_columns) if idx_cfg is not None else ()
             use_dict = f.data_type.is_string_like or (
@@ -313,6 +347,8 @@ class StackedTable:
                 entry["values"] = jax.device_put(c.values, row_sharding)
             if c.nulls is not None:
                 entry["nulls"] = jax.device_put(c.nulls, row_sharding)
+            if c.mv_lengths is not None:
+                entry["lengths"] = jax.device_put(c.mv_lengths, row_sharding)
             cache[cname] = entry
             out[cname] = entry
         if "__valid__" not in cache:

@@ -190,3 +190,29 @@ class TestUnnest:
         the explode runs over all matched rows, the trim at reduce)."""
         res = eng.query("SELECT UNNEST(tags) FROM mv LIMIT 7")
         assert len(res.rows) == 7
+
+
+class TestDistributedMV:
+    def test_stacked_mv_filters_and_aggs(self, data):
+        """MV columns ride the distributed stacked path: ANY-semantics
+        filters + MV aggregations over the 8-device mesh (round 4)."""
+        from pinot_tpu.parallel.engine import DistributedEngine
+        from pinot_tpu.parallel.stacked import StackedTable
+
+        st = StackedTable.build(_schema(), data, 8)
+        eng = DistributedEngine()
+        eng.register_table("mv", st)
+        res = eng.query("SELECT COUNT(*) FROM mv WHERE tags = 'red'")
+        assert res.rows[0][0] == sum(1 for t in data["tags"] if "red" in t)
+        res2 = eng.query("SELECT COUNTMV(scores), SUMMV(scores) FROM mv")
+        flat = [x for s in data["scores"] for x in s]
+        assert res2.rows[0][0] == len(flat)
+        assert res2.rows[0][1] == sum(flat)
+        res3 = eng.query("SELECT city, SUMMV(scores) FROM mv WHERE tags != 'gray' GROUP BY city ORDER BY city")
+        for row in res3.rows:
+            expected = sum(
+                sum(s)
+                for c, s, t in zip(data["city"], data["scores"], data["tags"])
+                if c == row[0] and any(x != "gray" for x in t)
+            )
+            assert row[1] == expected

@@ -481,6 +481,42 @@ class FirstWithTimeFunction(LastWithTimeFunction):
     pick_last = False
 
 
+class FrequentLongsFunction(ModeFunction):
+    """Top-k most frequent values over a bounded int range (reference:
+    FrequentLongsSketchAggregationFunction — theirs is an approximate
+    Frequent-Items sketch; ours is exact over the value-offset histogram).
+    Returns a list of values, most frequent first (ties: smaller value)."""
+
+    name = "frequentlongs"
+
+    def __init__(self, domain: int = 0, base: int = 0, k: int = 10):
+        super().__init__(domain=domain, base=base)
+        self.k = k
+
+    def with_args(self, literal_args):
+        k = int(literal_args[0]) if literal_args else 10
+        return FrequentLongsFunction(k=k)
+
+    def bind_column(self, info: ColumnBinding):
+        bound = ModeFunction.bind_column(self, info)
+        return FrequentLongsFunction(domain=bound.domain, base=bound.base, k=self.k)
+
+    def final(self, p):
+        hist = np.atleast_2d(np.asarray(p["hist"]))
+        lo = np.atleast_1d(np.asarray(p["lo"], dtype=np.int64))
+        out = np.empty(hist.shape[0], dtype=object)
+        for g in range(hist.shape[0]):
+            nz = np.nonzero(hist[g])[0]
+            # most frequent first; ties break to the smaller value (stable
+            # sort over -count keeps ascending offset order within ties)
+            top = nz[np.argsort(-hist[g][nz], kind="stable")][: self.k]
+            out[g] = [int(lo[g] + o) for o in top]
+        return out[0] if np.asarray(p["hist"]).ndim == 1 else out
+
+    def final_dtype(self):
+        return np.dtype(object)
+
+
 # ---------------------------------------------------------------------------
 # DISTINCTSUM / DISTINCTAVG: sum/avg over the DISTINCT values
 # ---------------------------------------------------------------------------
@@ -575,6 +611,7 @@ _EXTRA = (
     PercentileLogSketchFunction,
     DistinctCountThetaFunction,
     ModeFunction,
+    FrequentLongsFunction,
     DistinctSumFunction,
     DistinctAvgFunction,
     LastWithTimeFunction,

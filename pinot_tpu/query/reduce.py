@@ -68,15 +68,19 @@ def _reduce_aggregation(ctx: QueryContext, results: List[AggSegmentResult], stat
         if merged is None:
             val = 0 if fn.name == "count" else None  # all segments pruned
         else:
-            val = _scalar(fn.final(merged[i]))
-        _register_agg_env(env, spec, np.asarray([np.nan if val is None else val], dtype=object))
+            val = fn.final(merged[i])
+            if not isinstance(val, (list, tuple)):
+                val = _scalar(val)
+        cell = np.empty(1, dtype=object)  # explicit: np.asarray would
+        cell[0] = np.nan if val is None else val  # 2D-ify a list value
+        _register_agg_env(env, spec, cell)
     row = []
     for s in ctx.select_list:
         if isinstance(s, AggregationSpec):
             v = env[s.fingerprint()][0]
         else:
             v = _eval_env_expr(s, env, 1)[0]
-        row.append(_scalar(v) if not isinstance(v, (str, bytes, type(None))) else v)
+        row.append(_scalar(v) if not isinstance(v, (str, bytes, list, tuple, type(None))) else v)
     return ResultTable(columns=ctx.column_names_out(), rows=[tuple(row)], stats=stats)
 
 
@@ -387,7 +391,12 @@ def _running_window(fn: str, pid: np.ndarray, okeys, arg, n: int) -> np.ndarray:
 def _rows_from_columns(cols: Sequence[np.ndarray], n: int) -> List[tuple]:
     rows = []
     for i in range(n):
-        rows.append(tuple(_scalar(c[i]) if not isinstance(c[i], (str, bytes, type(None))) else c[i] for c in cols))
+        rows.append(
+            tuple(
+                _scalar(c[i]) if not isinstance(c[i], (str, bytes, list, tuple, type(None))) else c[i]
+                for c in cols
+            )
+        )
     return rows
 
 

@@ -288,3 +288,26 @@ class TestThetaSetExpressions:
         eng = _make_engine({"dim": dim.astype(object), "user": user}, schema)
         got = eng.query("SELECT DISTINCTCOUNTTHETA(user, 'dim = ''a$b''') FROM tdollar").rows[0][0]
         assert int(got) == len(set(user[dim == "a$b"].tolist()))
+
+
+class TestFrequentLongs:
+    def test_top_k_values(self):
+        rng = np.random.default_rng(59)
+        # zipf-ish: value i appears ~ (20 - i) * 100 times
+        parts = [np.full((20 - i) * 100, i) for i in range(20)]
+        v = np.concatenate(parts)
+        rng.shuffle(v)
+        g = rng.integers(0, 2, len(v))
+        schema = Schema(
+            "fl",
+            [FieldSpec("g", DataType.INT), FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)],
+        )
+        eng = _make_engine({"g": g, "v": v}, schema)
+        got = eng.query("SELECT FREQUENTLONGS(v, 5) FROM fl").rows[0][0]
+        assert got == [0, 1, 2, 3, 4]  # exact global frequency order
+        res = eng.query("SELECT g, FREQUENTLONGS(v, 3) FROM fl GROUP BY g ORDER BY g")
+        for row in res.rows:
+            vg = v[g == int(row[0])]
+            counts = np.bincount(vg)
+            expected = list(np.argsort(-counts, kind="stable")[:3])
+            assert row[1] == [int(x) for x in expected]

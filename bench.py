@@ -12,11 +12,17 @@ stay comparable):
 
   value / value_marginal  — MARGINAL per-query kernel time: K queries run
       inside one program (lax.fori_loop whose body depends on the loop index
-      so XLA cannot hoist it); (t_K - t_1)/(K - 1).  Excludes input
-      transfer and the host reduce tail (group-table-sized, row-count
-      independent).  Rationale: the axon relay re-ships every input buffer
-      per jitted call (~5-7 GB/s), which measures the tunnel, not the
-      engine; on a real TPU host columns stay pinned in HBM.
+      so XLA cannot hoist it); median slope over >=3 interleaved
+      (t_1, t_K) pairs, each the min of 3 runs (round-5 hardening: a
+      single pair understated r4 by 21x under relay contention).  The
+      estimate is cross-checked against the subtraction-free amortized
+      floor n*K/min(t_K); >25% disagreement triggers re-measurement, and
+      the reported value is max(median slope, amortized floor) with the
+      pair spread in `run_variance`.  Excludes input transfer and the
+      host reduce tail (group-table-sized, row-count independent).
+      Rationale: the axon relay re-ships every input buffer per jitted
+      call (~5-7 GB/s), which measures the tunnel, not the engine; on a
+      real TPU host columns stay pinned in HBM.
   value_e2e — full DistributedEngine.execute() wall clock (parse reuse,
       kernel, device_get, broker reduce), min of 3 after warm-up.  On the
       relay this includes per-call buffer re-shipping; on a real TPU host
@@ -129,7 +135,7 @@ def main() -> None:
     bits_key = next(iter(plan.row_sharded_params), None)
     hi_key = next((k for k in base_params if k.endswith(".hi")), None)
 
-    def timed_loop(k_iters: int):
+    def make_loop(k_iters: int):
         def run(cols, valid, params):
             def body(i, acc):
                 p = dict(params)
@@ -145,20 +151,60 @@ def main() -> None:
             return lax.fori_loop(0, k_iters, body, jnp.float64(0))
 
         fn = jax.jit(run)
-        out = fn(cols, valid, base_params)
-        jax.device_get(out)  # compile + first transfer
-        ts = []
-        for _ in range(2):
-            t0 = time.perf_counter()
-            out = fn(cols, valid, base_params)
-            jax.device_get(out)
-            ts.append(time.perf_counter() - t0)
-        return float(np.min(ts))
+        jax.device_get(fn(cols, valid, base_params))  # compile + first transfer
+        return fn
 
-    t_k = timed_loop(K_ITERS)
-    t_1 = timed_loop(1)
-    per_query = max((t_k - t_1) / (K_ITERS - 1), 1e-9)
-    rows_per_sec = n / per_query
+    def time_once(fn) -> float:
+        t0 = time.perf_counter()
+        jax.device_get(fn(cols, valid, base_params))
+        return time.perf_counter() - t0
+
+    fn_1 = make_loop(1)
+    fn_k = make_loop(K_ITERS)
+
+    # Round-5 hardening (VERDICT r4 #1): a single (t_1, t_K) pair is not
+    # robust to relay contention — one slow t_K understated r4 by 21x.
+    # Take the median slope over >=3 interleaved pairs (each timing the min
+    # of 3 runs), cross-check against the amortized lower bound
+    # n*K/min(t_K) — which cannot be corrupted by subtraction noise — and
+    # re-measure when the two disagree by >25%.  Report the max of the two
+    # (the amortized figure still *includes* fixed dispatch overhead, so it
+    # is a strict lower bound on marginal throughput), plus run variance.
+    def measure_pair():
+        t1 = min(time_once(fn_1) for _ in range(3))
+        tk = min(time_once(fn_k) for _ in range(3))
+        return t1, tk
+
+    pairs = [measure_pair() for _ in range(3)]
+
+    def summarize(ps):
+        # a contended t_1 can exceed t_K, making the slope non-positive —
+        # such pairs are invalid samples, not data; drop them rather than
+        # clamp (a clamp would publish an absurdly HIGH record).
+        slopes = [(tk - t1) / (K_ITERS - 1) for t1, tk in ps]
+        valid = [s for s in slopes if s > 0]
+        min_tk = min(tk for _, tk in ps)
+        amortized = n * K_ITERS / min_tk  # lower bound, subtraction-free
+        if not valid:
+            return None, 0.0, amortized, [], len(slopes)
+        per_query = float(np.median(valid))
+        return per_query, n / per_query, amortized, valid, len(slopes) - len(valid)
+
+    per_query, marg, amortized, slopes, n_invalid = summarize(pairs)
+    remeasured = 0
+    while (marg < 0.75 * amortized or not slopes) and remeasured < 2:
+        # slope estimate inconsistent with its own lower bound (or no valid
+        # pair at all): contention hit a timing run.  Gather more pairs.
+        pairs.extend(measure_pair() for _ in range(2))
+        per_query, marg, amortized, slopes, n_invalid = summarize(pairs)
+        remeasured += 1
+
+    # marg can only be trusted above the floor; with no valid slopes the
+    # subtraction-free amortized floor IS the measurement.
+    rows_per_sec = max(marg, amortized)
+    spread = (
+        (max(slopes) - min(slopes)) / float(np.median(slopes)) if slopes else -1.0
+    )
 
     print(
         json.dumps(
@@ -167,7 +213,12 @@ def main() -> None:
                 "value": round(rows_per_sec, 1),
                 "unit": "rows/sec",
                 "vs_baseline": round(rows_per_sec / JAVA_SERVER_ROWS_PER_SEC, 3),
-                "value_marginal": round(rows_per_sec, 1),
+                "value_marginal": round(marg, 1),
+                "value_amortized_floor": round(amortized, 1),
+                "run_variance": round(spread, 4),
+                "timing_pairs": [[round(a, 4), round(b, 4)] for a, b in pairs],
+                "invalid_pairs": n_invalid,
+                "remeasure_rounds": remeasured,
                 "value_e2e": round(n / e2e, 1),
                 "e2e_seconds": round(e2e, 4),
                 "rows": n,

@@ -274,7 +274,10 @@ class DistributedEngine:
         planner_mod.guard_sparse_vector_fields(kind, aggs)
         if any(gd.mv for gd in group_dims):
             raise NotImplementedError("MV GROUP BY (explode) is not yet supported on the distributed stacked path")
-        if any(fn.pairwise_merge for fn in aggs):
+        if kind in ("aggregation", "groupby_dense") and any(fn.pairwise_merge for fn in aggs):
+            # the sparse path merges per-device tables HOST-side (pairwise
+            # fn.merge in sparse_tables_to_result), so only the in-graph
+            # psum-combined paths exclude coupled partials
             raise NotImplementedError(
                 "pairwise-merge aggregations (FIRST/LAST_WITH_TIME, DISTINCTCOUNTTHETA) "
                 "cannot ride the in-graph psum combine; run them on the single-node engine"
@@ -334,6 +337,11 @@ class DistributedEngine:
             if num_groups >= (1 << 62):
                 raise NotImplementedError("composite group key exceeds 62 bits")
             num_slots = min(ctx.num_groups_limit, num_groups)
+            # per-device ORDER BY-aware trim: each device keeps its LOCAL
+            # top-num_slots groups by the comparator (groups split across
+            # devices rank by local partials — the same accuracy valve as
+            # the reference's server-side numGroupsLimit trim)
+            order_spec = planner_mod.kernel_order_spec(ctx, aggs)
 
             def shard_kernel(cols, valid, params):
                 cols = _flat(cols)
@@ -341,7 +349,9 @@ class DistributedEngine:
                 tmask = tmask & valid.reshape(-1)
                 key = planner_mod.packed_key64(cols, group_dims, view)
                 inputs = _agg_inputs(cols, params, tmask)
-                return planner_mod.sparse_grouped_tables(aggs, inputs, tmask, key, num_slots)
+                return planner_mod.sparse_grouped_tables(
+                    aggs, inputs, tmask, key, num_slots, order_spec
+                )
 
             out_specs = P(self.axis)
 
@@ -450,14 +460,18 @@ class DistributedEngine:
                 group_dims=plan.group_dims,
             )
             shim = SimpleNamespace(group_dims=plan.group_dims, aggs=plan.aggs)
-            keys, sliced = sse_executor._dense_to_present(shim, presence, partials, ctx.num_groups_limit)
+            keys, sliced = sse_executor._dense_to_present(
+                shim, presence, partials, ctx.num_groups_limit,
+                order_trim=planner_mod.order_by_agg_index(ctx),
+            )
             stats.num_groups = len(keys[0]) if keys else 0
             return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense)
 
         if plan.kind == "groupby_sparse":
             uniq, partials = jax.device_get(plan.fn(cols, valid, params))
             res = sse_executor.sparse_tables_to_result(
-                plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit
+                plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit,
+                order_trim=planner_mod.order_by_agg_index(ctx),
             )
             stats.num_groups = len(res.keys[0]) if res.keys else 0
             return res

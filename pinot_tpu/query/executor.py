@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from pinot_tpu.query import planner
-from pinot_tpu.query.functions import FIELD_COMBINE, field_identity
+from pinot_tpu.query.functions import combine_field
 from pinot_tpu.query.ir import Expr, FilterNode, FilterOp, PredicateType, QueryContext
 from pinot_tpu.query.transform import eval_expr_host
 from pinot_tpu.query.result import (
@@ -143,13 +143,19 @@ def collect_segment(state):
             key_space=_key_space_id(plan),
             group_dims=plan.group_dims,
         )
-        keys, sliced = _dense_to_present(plan, presence, partials, ctx.num_groups_limit)
+        keys, sliced = _dense_to_present(
+            plan, presence, partials, ctx.num_groups_limit,
+            order_trim=planner.order_by_agg_index(ctx),
+        )
         stats.num_groups = len(keys[0]) if keys else 0
         return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense), stats
 
     if plan.kind == "groupby_sparse":
         uniq, partials = jax.device_get(out)
-        res = sparse_tables_to_result(plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit)
+        res = sparse_tables_to_result(
+            plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit,
+            order_trim=planner.order_by_agg_index(ctx),
+        )
         stats.num_groups = len(res.keys[0]) if res.keys else 0
         return res, stats
 
@@ -173,24 +179,58 @@ def _key_space_id(plan) -> Tuple:
     return tuple(parts)
 
 
+def _order_trim_select(aggs, partials_for, candidates_key, order_trim, limit):
+    """Indices (into the candidate set) surviving an ORDER BY-aware trim:
+    rank by the order aggregation's FINAL value (NaN last), tie-break by
+    packed key — the TableResizer comparator analog.  Returns None when the
+    order value is not rankable (object finals), signalling the caller to
+    fall back to the deterministic lowest-key trim."""
+    idx, asc = order_trim
+    try:
+        vals = np.asarray(aggs[idx].final(partials_for(idx)))
+    except Exception:
+        return None
+    if vals.dtype == object or not np.issubdtype(vals.dtype, np.number):
+        return None
+    k = vals.astype(np.float64)
+    if not asc:
+        k = -k
+    k = np.where(np.isnan(k), np.inf, k)
+    sel = np.lexsort((candidates_key, k))[:limit]
+    sel.sort()
+    return sel
+
+
 def _dense_to_present(
-    plan, presence: np.ndarray, partials, num_groups_limit: Optional[int] = None
+    plan, presence: np.ndarray, partials, num_groups_limit: Optional[int] = None,
+    order_trim: Optional[Tuple[int, bool]] = None,
 ) -> Tuple[List[np.ndarray], List[Dict]]:
     """Dense table -> (decoded keys, partials) for present groups only.
 
     num_groups_limit caps TRACKED groups (the numGroupsLimit safety valve,
-    InstancePlanMakerImplV2.java:100-120) — lowest packed keys win, matching
-    the sparse path's documented deterministic trim."""
+    InstancePlanMakerImplV2.java:100-120).  With an ORDER BY over an
+    aggregate, the trim ranks groups by the comparator (TableResizer.java
+    analog); otherwise lowest packed keys win (deterministic)."""
     present = np.nonzero(presence > 0)[0]
     if num_groups_limit is not None and len(present) > num_groups_limit:
-        present = present[:num_groups_limit]
+        sel = None
+        if order_trim is not None:
+            sel = _order_trim_select(
+                plan.aggs,
+                lambda i: {f: np.asarray(a)[present] for f, a in partials[i].items()},
+                present,
+                order_trim,
+                num_groups_limit,
+            )
+        present = present[sel] if sel is not None else present[:num_groups_limit]
     keys = planner.decode_packed_keys(plan.group_dims, present)
     sliced = [{f: np.asarray(arr)[present] for f, arr in p.items()} for p in partials]
     return keys, sliced
 
 
 def sparse_tables_to_result(
-    group_dims, aggs, uniq, partials, num_groups_limit: int
+    group_dims, aggs, uniq, partials, num_groups_limit: int,
+    order_trim: Optional[Tuple[int, bool]] = None,
 ) -> GroupBySegmentResult:
     """Decode fixed-size sparse group tables (planner.sparse_grouped_tables)
     into a GroupBySegmentResult, merging slots that share a key.
@@ -204,39 +244,64 @@ def sparse_tables_to_result(
     present = uniq != planner.SPARSE_EMPTY_KEY
     keys_flat = uniq[present]
     u, inverse = np.unique(keys_flat, return_inverse=True)
-    if len(u) > num_groups_limit:
+    if len(u) > num_groups_limit and order_trim is None:
         # numGroupsLimit safety valve (InstancePlanMakerImplV2.java:100-120):
-        # lowest packed keys win — deterministic, documented trim.
+        # lowest packed keys win — deterministic, documented trim.  With an
+        # ORDER BY comparator the trim instead happens AFTER the fold below,
+        # over fully merged per-group partials (TableResizer analog).
         keep = inverse < num_groups_limit
         u = u[:num_groups_limit]
+        inverse = inverse[keep]
     else:
         keep = None
     n_groups = len(u)
-    keys = planner.decode_packed_keys(group_dims, u)
+
+    # Padded per-group row matrix: mat[g] lists the slot rows carrying key g
+    # (-1 padding).  Duplicate keys only arise on the multi-device shape, so
+    # the fold depth is <= ndev; one vectorized combine per fold level merges
+    # every group at once — scalar fields, vector fields (present/hll/hist
+    # [slots, W]) and pairwise-coupled partials (KMV, (t, v)) all ride it.
+    counts = np.bincount(inverse, minlength=n_groups) if len(inverse) else np.zeros(n_groups, np.int64)
+    maxc = int(counts.max(initial=1))
+    order = np.argsort(inverse, kind="stable")
+    starts = np.zeros(n_groups, dtype=np.int64)
+    np.cumsum(counts[:-1], out=starts[1:] if n_groups > 1 else starts[:0])
+    mat = np.full((n_groups, maxc), -1, dtype=np.int64)
+    if len(order):
+        col = np.arange(len(order)) - starts[inverse[order]]
+        mat[inverse[order], col] = order
+
+    first = np.maximum(mat[:, 0], 0)
     out: List[Dict[str, np.ndarray]] = []
     for fn, p in zip(aggs, partials):
-        d: Dict[str, np.ndarray] = {}
+        rows: Dict[str, np.ndarray] = {}
         for fname, arr in p.items():
-            a = np.asarray(arr).reshape(-1)[present]
-            inv = inverse
-            if keep is not None:
-                a = a[keep]
-                inv = inverse[keep]
-            comb = FIELD_COMBINE[fname]
-            if comb == "add":
-                if fname == "count":
-                    acc = np.zeros(n_groups, dtype=np.int64)
-                    np.add.at(acc, inv, a)
-                else:
-                    acc = np.bincount(inv, weights=a, minlength=n_groups)
+            a = np.asarray(arr)
+            a = a[present] if keep is None else a[present][keep]
+            rows[fname] = a
+        acc = {f: a[first] for f, a in rows.items()}
+        for j in range(1, maxc):
+            validj = mat[:, j] >= 0
+            if not validj.any():
+                break
+            idx = np.maximum(mat[:, j], 0)
+            other = {f: a[idx] for f, a in rows.items()}
+            if getattr(fn, "pairwise_merge", False):
+                merged = fn.merge(acc, other)
             else:
-                acc = np.full(n_groups, field_identity(fname))
-                if comb == "min":
-                    np.minimum.at(acc, inv, a)
-                else:
-                    np.maximum.at(acc, inv, a)
-            d[fname] = acc
-        out.append(d)
+                merged = {f: combine_field(f, acc[f], other[f]) for f in acc}
+            for f in acc:
+                v = validj.reshape((-1,) + (1,) * (acc[f].ndim - 1))
+                acc[f] = np.where(v, merged[f], acc[f])
+        out.append(acc)
+
+    if order_trim is not None and n_groups > num_groups_limit:
+        sel = _order_trim_select(aggs, lambda i: out[i], u, order_trim, num_groups_limit)
+        if sel is None:
+            sel = np.arange(num_groups_limit)  # u is sorted: lowest keys
+        u = u[sel]
+        out = [{f: a[sel] for f, a in p.items()} for p in out]
+    keys = planner.decode_packed_keys(group_dims, u)
     return GroupBySegmentResult(keys=keys, partials=out, dense=None)
 
 

@@ -263,13 +263,28 @@ def const_bound_columns(ctx: QueryContext) -> frozenset:
 
 
 def guard_sparse_vector_fields(kind: str, aggs: List[AggFunction]) -> None:
-    """Vector-partial aggregations (presence/registers/histograms) cannot
-    ride the scalar-field host sparse-groupby fallback."""
-    if kind == "groupby_sparse" and any(fn.vector_fields for fn in aggs):
-        raise NotImplementedError(
-            "sketch aggregations (DISTINCTCOUNT/HLL/PERCENTILE) require the dense "
-            "group path; lower group-key cardinality or raise maxDenseGroups"
-        )
+    """Pre-trace check for the sparse group path.  Round 5: vector-field
+    sketches (DISTINCTCOUNT/HLL/PERCENTILE/MODE/theta/...) now ride the
+    sparse kernel through their own partial_grouped over slot ids
+    (sparse_grouped_tables), matching the reference's high-cardinality
+    group-by with any aggregation (DefaultGroupByExecutor.java:51 + object
+    result holders).  Only genuinely un-groupable forms raise early with a
+    pointed message instead of failing mid-trace."""
+    if kind != "groupby_sparse":
+        return
+    from pinot_tpu.query.sketches import DistinctCountValueSetFunction
+
+    for fn in aggs:
+        base = getattr(fn, "base", fn)  # MV wrappers delegate
+        if isinstance(base, DistinctCountValueSetFunction):
+            raise NotImplementedError(
+                "exact grouped DISTINCTCOUNT requires a shared dictionary across "
+                "segments; these segments' dictionaries differ — use DISTINCTCOUNTHLL"
+            )
+        if getattr(fn, "subfilter_args", False):
+            raise NotImplementedError(
+                "theta sub-filter set expressions do not support GROUP BY"
+            )
 
 
 def _all_column_names(segment) -> List[str]:
@@ -636,6 +651,58 @@ def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges):
 SPARSE_EMPTY_KEY = np.int64(np.iinfo(np.int64).max)
 
 
+def order_by_agg_index(ctx: QueryContext) -> Optional[Tuple[int, bool]]:
+    """Map the FIRST ORDER BY expression to an index into ctx.aggregations
+    (by alias or by call shape).  The trim paths use it to rank groups by
+    the ORDER BY comparator before dropping any — the TableResizer analog
+    (pinot-core/.../core/data/table/TableResizer.java) replacing the
+    round-4 lowest-packed-key trim that could drop the true top groups of
+    a `GROUP BY hi_card ORDER BY SUM(x) DESC LIMIT k` query."""
+    if not ctx.order_by:
+        return None
+    ob = ctx.order_by[0]
+    e = ob.expr
+    specs = list(ctx.aggregations)
+    if e.is_column:
+        # alias of a select aggregation
+        for s, a in zip(ctx.select_list, ctx.select_aliases):
+            if a == e.op and isinstance(s, AggregationSpec):
+                fp = s.fingerprint()
+                for i, sp in enumerate(specs):
+                    if sp.fingerprint() == fp:
+                        return i, ob.ascending
+        return None
+    if e.kind.name != "CALL":
+        return None
+    for i, sp in enumerate(specs):
+        if sp.filter is not None or sp.extra_exprs or sp.literal_args:
+            continue
+        if e.op.lower() != sp.function.lower():
+            continue
+        if sp.expr is None:
+            if not e.args or (len(e.args) == 1 and e.args[0].is_column and e.args[0].op == "*"):
+                return i, ob.ascending
+        elif len(e.args) == 1 and e.args[0].fingerprint() == sp.expr.fingerprint():
+            return i, ob.ascending
+    return None
+
+
+def kernel_order_spec(ctx: QueryContext, aggs: List[AggFunction]) -> Optional[Tuple[int, str, bool]]:
+    """(agg index, contribution mode, ascending) when the first ORDER BY key
+    is an aggregate whose per-group order value the sparse kernel can derive
+    in one pass: additive sum/count via a segment cumsum, min/max via a
+    secondary sort key.  None falls back to the lowest-packed-key trim."""
+    hit = order_by_agg_index(ctx)
+    if hit is None:
+        return None
+    i, asc = hit
+    fn = aggs[i]
+    mode = {"sum": "sum", "count": "count", "min": "min", "max": "max"}.get(fn.name)
+    if mode is None or getattr(fn, "mv_input", False) or getattr(fn, "needs_extra_exprs", False):
+        return None
+    return i, mode, asc
+
+
 def packed_key64(cols, group_dims, segment) -> jnp.ndarray:
     """Ravel per-dim codes into one int64 key (device side).  The planner
     guards the key space to < 2^62 before choosing the sparse path."""
@@ -646,7 +713,7 @@ def packed_key64(cols, group_dims, segment) -> jnp.ndarray:
     return key
 
 
-def sparse_grouped_tables(aggs, inputs, tmask, key, num_slots: int):
+def sparse_grouped_tables(aggs, inputs, tmask, key, num_slots: int, order_spec=None):
     """Device-side high-cardinality group-by: sort + segment-scatter into
     FIXED-size tables (the IndexedTable analog with numGroupsLimit trim
     built into the kernel).
@@ -673,13 +740,76 @@ def sparse_grouped_tables(aggs, inputs, tmask, key, num_slots: int):
     n = tmask.shape[0]
     k64 = jnp.where(tmask, key, SPARSE_EMPTY_KEY)
     iota = jnp.arange(n, dtype=jnp.int32)
-    skey, perm = lax.sort((k64, iota), num_keys=1)
+    if order_spec is not None and order_spec[1] in ("min", "max"):
+        # min/max order value rides the row sort as a secondary key: after
+        # sorting by (key, ±value) the group's extremum sits at its start row
+        oi, omode, _ = order_spec
+        ov_raw, om = inputs[oi]
+        ovr = ov_raw.astype(jnp.float64)
+        ovr = ovr if omode == "min" else -ovr
+        ovr = jnp.where(om, ovr, jnp.inf)
+        skey, sov, perm = lax.sort((k64, ovr, iota), num_keys=2)
+    else:
+        sov = None
+        skey, perm = lax.sort((k64, iota), num_keys=1)
     smask = tmask[perm]
     prev = jnp.concatenate([jnp.full((1,), -1, skey.dtype), skey[:-1]])
     is_start = smask & (skey != prev)
     seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
-    # slot num_slots = overflow/invalid bin, sliced off before returning
-    slot = jnp.where(smask & (seg < num_slots), seg, num_slots)
+    if order_spec is None:
+        # slot num_slots = overflow/invalid bin, sliced off before returning;
+        # first-num_slots-groups-by-packed-key trim (deterministic)
+        slot = jnp.where(smask & (seg < num_slots), seg, num_slots)
+    else:
+        # ORDER BY-aware trim (TableResizer analog): compute each group's
+        # order value in-row-space, rank groups by (order value, packed key)
+        # on device, and give slots to the top num_slots groups only.
+        oi, omode, asc = order_spec
+        if sov is not None:
+            empty = jnp.isinf(sov)  # no agg-mask rows in the group: NULL
+            group_ov = sov  # valid at start rows: the group's min / -max
+            group_ov = group_ov if asc else -group_ov
+            # sov carries -v for max, so one more flip restores the sign
+            if omode == "max":
+                group_ov = -group_ov
+            # NULL order values rank LAST in every direction (matching the
+            # host-side _order_trim_select NaN handling); clamp keeps them
+            # FINITE so the finite check below still marks the group
+            # rankable instead of dropping it (review-caught)
+            group_ov = jnp.clip(jnp.where(empty, jnp.inf, group_ov), -1e300, 1e300)
+        else:
+            ov_raw, om = inputs[oi]
+            if omode == "count":
+                c = om.astype(jnp.float64)
+            else:
+                v = ov_raw if getattr(ov_raw, "ndim", 0) else jnp.broadcast_to(ov_raw, (n,))
+                c = jnp.where(om, v.astype(jnp.float64), 0.0)
+            cp = c[perm]
+            s0 = jnp.concatenate([jnp.zeros((1,), jnp.float64), jnp.cumsum(cp)])
+            # smallest start index >= i, from the right; strict next start
+            starts_at = jnp.where(is_start, iota, np.int32(n))
+            nxt_ge = lax.cummin(starts_at[::-1])[::-1]
+            nxt = jnp.concatenate([nxt_ge[1:], jnp.full((1,), n, jnp.int32)])
+            total = s0[nxt] - s0[iota]  # valid at start rows
+            group_ov = total if asc else -total
+            if omode == "sum":
+                # SUM over zero agg-mask rows is SQL NULL, not 0: count the
+                # mask the same way and send empty groups to rank-last
+                mp = om.astype(jnp.float64)[perm]
+                m0 = jnp.concatenate([jnp.zeros((1,), jnp.float64), jnp.cumsum(mp)])
+                group_ov = jnp.clip(
+                    jnp.where((m0[nxt] - m0[iota]) > 0, group_ov, jnp.inf), -1e300, 1e300
+                )
+        ovkey = jnp.where(is_start, group_ov, jnp.inf)
+        sovk, sskey, sseg = lax.sort((ovkey, skey, seg), num_keys=2)
+        rank = jnp.minimum(iota, np.int32(num_slots))
+        ranks = (
+            jnp.full((n + 1,), num_slots, dtype=jnp.int32)
+            .at[jnp.where(jnp.isfinite(sovk), sseg, np.int32(n))]
+            .set(rank, mode="drop")
+        )
+        gslot = ranks[jnp.minimum(seg, np.int32(n))]
+        slot = jnp.where(smask & (gslot < num_slots), gslot, num_slots)
     uniq = (
         jnp.full((num_slots + 1,), SPARSE_EMPTY_KEY, dtype=jnp.int64)
         .at[jnp.where(is_start, slot, num_slots)]
@@ -688,8 +818,22 @@ def sparse_grouped_tables(aggs, inputs, tmask, key, num_slots: int):
     partials = []
     for fn, (vals, mask) in zip(aggs, inputs):
         m = mask[perm]
-        v = vals if getattr(vals, "ndim", 0) else jnp.broadcast_to(vals, (n,))
-        v = v[perm]
+
+        def _perm(x):
+            x = x if getattr(x, "ndim", 0) else jnp.broadcast_to(x, (n,))
+            return x[perm]
+
+        if fn.field_kinds is None:
+            # sketch / own-scatter family (HLL registers, presence bitmaps,
+            # histograms, KMV, (t, v) pairs, MV wrappers): the slot array IS
+            # a dense group-key space of num_slots+1 ids, so the function's
+            # own partial_grouped scatters per-slot vector fields directly;
+            # the overflow slot is sliced off like the scalar tables.
+            v = tuple(_perm(x) for x in vals) if isinstance(vals, tuple) else _perm(vals)
+            own = fn.partial_grouped(v, m, slot, num_slots + 1)
+            partials.append({f: t[:num_slots] for f, t in own.items()})
+            continue
+        v = _perm(vals)
         p: Dict[str, Any] = {}
         for fname in fn.fields:
             comb = FIELD_COMBINE[fname]
@@ -910,13 +1054,14 @@ def _build_plan(
         if num_groups >= (1 << 62):
             raise NotImplementedError("composite group key exceeds 62 bits")
         num_slots = min(ctx.num_groups_limit, num_groups)
+        order_spec = kernel_order_spec(ctx, aggs)
 
         if mv_i is not None:
 
             def kernel(cols, params):
                 tmask, _ = filter_fn(cols, params)
                 key, t_f, inputs = _mv_explode(cols, params, tmask, jnp.int64)
-                return sparse_grouped_tables(aggs, inputs, t_f, key, num_slots)
+                return sparse_grouped_tables(aggs, inputs, t_f, key, num_slots, order_spec)
 
         else:
 
@@ -924,7 +1069,7 @@ def _build_plan(
                 tmask, _ = filter_fn(cols, params)
                 key = packed_key64(cols, group_dims, segment)
                 inputs = _agg_inputs(cols, params, tmask)
-                return sparse_grouped_tables(aggs, inputs, tmask, key, num_slots)
+                return sparse_grouped_tables(aggs, inputs, tmask, key, num_slots, order_spec)
 
     elif kind == "selection":
 

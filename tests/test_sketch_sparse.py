@@ -1,0 +1,186 @@
+"""Sketch aggregations on the SPARSE group-by path (round-5 VERDICT #3).
+
+The reference handles high-cardinality group-by with ANY aggregation
+(pinot-core/.../query/aggregation/groupby/DefaultGroupByExecutor.java:51 +
+object result holders).  Here the sparse sort-scatter kernel hands each
+vector-field function its own partial_grouped over slot ids
+(planner.sparse_grouped_tables), so `SET maxDenseGroups=<small>` forcing
+the sparse path must produce results identical to the dense path / sqlite.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.parallel.engine import DistributedEngine
+from pinot_tpu.parallel.stacked import StackedTable
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.sql.parser import parse_query
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+from golden import assert_same_rows, sqlite_from_data
+
+N = 40_000
+SPARSE = "SET maxDenseGroups = 2; "
+
+
+def _schema():
+    return Schema(
+        "sk",
+        [
+            FieldSpec("g", DataType.INT),
+            FieldSpec("v", DataType.INT),
+            FieldSpec("w", DataType.DOUBLE, role=FieldRole.METRIC),
+            FieldSpec("t", DataType.LONG),
+            FieldSpec("s", DataType.STRING),
+        ],
+    )
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(23)
+    return {
+        "g": rng.integers(0, 40, N).astype(np.int32),
+        "v": rng.integers(0, 900, N).astype(np.int32),
+        "w": np.round(rng.random(N) * 1000, 3),
+        "t": rng.integers(0, 10_000, N),
+        "s": np.array([f"u{int(x)}" for x in rng.integers(0, 300, N)], dtype=object),
+    }
+
+
+@pytest.fixture(scope="module")
+def sse(data):
+    eng = QueryEngine()
+    eng.register_table(_schema())
+    eng.add_segment("sk", build_segment(_schema(), data, "s0"))
+    return eng
+
+
+@pytest.fixture(scope="module")
+def conn(data):
+    return sqlite_from_data("sk", data)
+
+
+def _forced_sparse(engine, sql):
+    """Run with maxDenseGroups=2 and assert the sparse plan actually ran."""
+    ctx = parse_query(SPARSE + sql)
+    assert ctx.max_dense_groups == 2
+    return engine.execute(ctx)
+
+
+class TestSketchOnSparsePath:
+    def test_plan_kind_is_sparse(self, sse):
+        from pinot_tpu.query import planner
+
+        ctx = parse_query(SPARSE + "SELECT g, DISTINCTCOUNTHLL(s) FROM sk GROUP BY g")
+        plan = planner.plan_segment(ctx, sse.table("sk").segments[0])
+        assert plan.kind == "groupby_sparse"
+
+    def test_exact_distinctcount_vs_sqlite(self, sse, conn):
+        sql = "SELECT g, DISTINCTCOUNT(v) FROM sk GROUP BY g ORDER BY g LIMIT 100"
+        got = _forced_sparse(sse, sql)
+        exp = conn.execute(
+            "SELECT g, COUNT(DISTINCT v) FROM sk GROUP BY g ORDER BY g LIMIT 100"
+        ).fetchall()
+        assert_same_rows(got.rows, exp, ordered=True)
+
+    def test_distinctcount_string_vs_sqlite(self, sse, conn):
+        sql = "SELECT g, DISTINCTCOUNT(s) FROM sk GROUP BY g ORDER BY g LIMIT 100"
+        got = _forced_sparse(sse, sql)
+        exp = conn.execute(
+            "SELECT g, COUNT(DISTINCT s) FROM sk GROUP BY g ORDER BY g LIMIT 100"
+        ).fetchall()
+        assert_same_rows(got.rows, exp, ordered=True)
+
+    @pytest.mark.parametrize(
+        "agg",
+        [
+            "DISTINCTCOUNTHLL(s)",
+            "PERCENTILE(w, 95)",
+            "PERCENTILEKLL(w, 50)",
+            "MODE(v)",
+            "DISTINCTCOUNTTHETA(v)",
+            "LASTWITHTIME(v, t, 'LONG')",
+            "FIRSTWITHTIME(v, t, 'LONG')",
+        ],
+    )
+    def test_sparse_matches_dense(self, sse, agg):
+        """Same registers/histograms/sketches must come out of both paths."""
+        sql = f"SELECT g, {agg} FROM sk GROUP BY g ORDER BY g LIMIT 100"
+        dense = sse.query(sql)
+        sparse = _forced_sparse(sse, sql)
+        assert_same_rows(sparse.rows, dense.rows, ordered=True)
+
+    def test_mixed_scalar_and_sketch(self, sse, conn):
+        sql = (
+            "SELECT g, COUNT(*), SUM(v), DISTINCTCOUNT(v) FROM sk "
+            "GROUP BY g ORDER BY g LIMIT 100"
+        )
+        got = _forced_sparse(sse, sql)
+        exp = conn.execute(
+            "SELECT g, COUNT(*), SUM(v), COUNT(DISTINCT v) FROM sk "
+            "GROUP BY g ORDER BY g LIMIT 100"
+        ).fetchall()
+        assert_same_rows(got.rows, exp, ordered=True)
+
+    def test_filtered_sketch_sparse(self, sse, conn):
+        sql = (
+            "SELECT g, DISTINCTCOUNT(v) FROM sk WHERE w > 500 "
+            "GROUP BY g ORDER BY g LIMIT 100"
+        )
+        got = _forced_sparse(sse, sql)
+        exp = conn.execute(
+            "SELECT g, COUNT(DISTINCT v) FROM sk WHERE w > 500 "
+            "GROUP BY g ORDER BY g LIMIT 100"
+        ).fetchall()
+        assert_same_rows(got.rows, exp, ordered=True)
+
+    def test_high_cardinality_composite_key_hll(self, sse):
+        """The actual bread-and-butter shape: a genuinely high-card composite
+        key (40 x 900 = 36k groups) with DISTINCTCOUNTHLL — sparse by
+        default config, trimmed to numGroupsLimit."""
+        ctx = parse_query(
+            "SET maxDenseGroups = 16; SET numGroupsLimit = 1000; "
+            "SELECT g, v, DISTINCTCOUNTHLL(s, 8) FROM sk "
+            "GROUP BY g, v ORDER BY g, v LIMIT 50"
+        )
+        res = sse.execute(ctx)
+        assert len(res.rows) == 50
+        # log2m=8 keeps the DENSE comparison under the cell budget too (the
+        # sparse path at numGroupsLimit=1000 slots fits even log2m=12)
+        dense = sse.query(
+            "SELECT g, v, DISTINCTCOUNTHLL(s, 8) FROM sk GROUP BY g, v ORDER BY g, v LIMIT 50"
+        )
+        assert_same_rows(res.rows, dense.rows, ordered=True)
+
+
+class TestDistributedSketchSparse:
+    @pytest.fixture(scope="class")
+    def dist(self, data):
+        st = StackedTable.build(_schema(), data, 8)
+        eng = DistributedEngine()
+        eng.register_table("sk", st)
+        return eng
+
+    def test_distributed_hll_sparse_matches_dense(self, dist):
+        sql = "SELECT g, DISTINCTCOUNTHLL(s) FROM sk GROUP BY g ORDER BY g LIMIT 100"
+        dense = dist.query(sql)
+        sparse = dist.query(SPARSE + sql)
+        assert_same_rows(sparse.rows, dense.rows, ordered=True)
+
+    def test_distributed_exact_distinctcount_sparse(self, dist, conn):
+        """Cross-device slot merge must UNION presence bitmaps, not add."""
+        sql = "SELECT g, DISTINCTCOUNT(v) FROM sk GROUP BY g ORDER BY g LIMIT 100"
+        got = dist.query(SPARSE + sql)
+        exp = conn.execute(
+            "SELECT g, COUNT(DISTINCT v) FROM sk GROUP BY g ORDER BY g LIMIT 100"
+        ).fetchall()
+        assert_same_rows(got.rows, exp, ordered=True)
+
+    def test_distributed_lastwithtime_sparse(self, dist, sse):
+        """Pairwise-merge partials fold across device tables host-side
+        (time-ties resolve to max v on both paths, so results are exact)."""
+        sql = "SELECT g, LASTWITHTIME(v, t, 'LONG') FROM sk GROUP BY g ORDER BY g LIMIT 100"
+        sparse = dist.query(SPARSE + sql)
+        dense_single = sse.query(sql)
+        assert_same_rows(sparse.rows, dense_single.rows, ordered=True)

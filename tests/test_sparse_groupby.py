@@ -118,6 +118,114 @@ class TestSparseGroupBy:
         assert sorted(map(tuple, res.rows)) == sorted(map(tuple, res2.rows))
 
 
+class TestOrderByAwareTrim:
+    """TableResizer analog (pinot-core/.../core/data/table/TableResizer.java):
+    when groups exceed numGroupsLimit and the query ORDERs BY an aggregate,
+    the trim must keep the comparator's top groups, not the lowest packed
+    keys (round-5 VERDICT #4)."""
+
+    def _engine(self, data):
+        eng = QueryEngine()
+        eng.register_table(_schema())
+        eng.add_segment("hc", build_segment(_schema(), data, "s0"))
+        return eng
+
+    @pytest.fixture(scope="class")
+    def skewed(self):
+        rng = np.random.default_rng(99)
+        n = 30_000
+        # keys 1200..1399 are "hot": huge v sums; packed-key trim would keep
+        # the LOWEST keys and miss every one of them
+        k = rng.integers(0, 1400, n).astype(np.int32)
+        v = np.where(k >= 1200, 1_000_000 + k.astype(np.int64), rng.integers(1, 100, n))
+        return {
+            "k1": k,
+            "k2": np.zeros(n, dtype=np.int32),
+            "v": v.astype(np.int64),
+            "w": rng.random(n),
+        }
+
+    @pytest.mark.parametrize(
+        "agg,eng_order,sql_order",
+        [
+            ("SUM(v)", "SUM(v) DESC", "SUM(v) DESC"),
+            ("COUNT(*)", "COUNT(*) DESC", "COUNT(*) DESC"),
+            ("MAX(v)", "MAX(v) DESC", "MAX(v) DESC"),
+            ("MIN(v)", "MIN(v) ASC", "MIN(v) ASC"),
+            ("SUM(v)", "s DESC", "SUM(v) DESC"),  # alias resolution
+        ],
+    )
+    def test_sparse_trim_keeps_true_top(self, skewed, agg, eng_order, sql_order):
+        eng = self._engine(skewed)
+        conn = sqlite_from_data("hc", skewed)
+        sql = f"SELECT k1, {agg} AS s FROM hc GROUP BY k1 ORDER BY {sql_order}, k1 LIMIT 10"
+        ctx = parse_query(
+            f"SET maxDenseGroups = 2; SET numGroupsLimit = 50; "
+            f"SELECT k1, {agg} AS s FROM hc GROUP BY k1 ORDER BY {eng_order}, k1 LIMIT 10"
+        )
+        got = eng.execute(ctx)
+        exp = conn.execute(sql).fetchall()
+        assert_same_rows(got.rows, exp, ordered=True)
+
+    @pytest.mark.parametrize("order", ["MIN(w) DESC", "MAX(w) ASC", "SUM(w) DESC"])
+    def test_null_group_ranks_last_in_kernel_trim(self, order):
+        """A group whose order-agg values are all NULL must rank LAST in
+        every direction (review-caught: the +inf sentinel flipped sign for
+        MIN DESC / MAX ASC and evicted true top groups)."""
+        rng = np.random.default_rng(7)
+        n = 8_000
+        k = rng.integers(0, 200, n).astype(np.int32)
+        w = rng.random(n) * 100 + 1
+        w[k == 0] = np.nan  # group 0: all NULL order values
+        data = {"k1": k, "k2": np.zeros(n, np.int32), "v": np.ones(n, np.int64), "w": w}
+        schema = Schema(
+            "hc",
+            [
+                FieldSpec("k1", DataType.INT),
+                FieldSpec("k2", DataType.INT),
+                FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("w", DataType.DOUBLE, role=FieldRole.METRIC, nullable=True),
+            ],
+        )
+        eng = QueryEngine()
+        eng.register_table(schema)
+        eng.add_segment("hc", build_segment(schema, data, "s0"))
+        sql = f"SELECT k1, COUNT(*) FROM hc GROUP BY k1 ORDER BY {order}, k1 LIMIT 5"
+        ctx = parse_query("SET maxDenseGroups = 2; SET numGroupsLimit = 20; " + sql)
+        got = eng.execute(ctx)
+        # ground truth: the untrimmed dense path (engine NULLS-LAST default;
+        # sqlite's NULLS-smallest convention differs on the ASC cases)
+        exp = eng.query(sql)
+        assert_same_rows(got.rows, exp.rows, ordered=True)
+
+    def test_dense_trim_keeps_true_top(self, skewed):
+        """Dense-path numGroupsLimit trim ranks by the comparator too —
+        including non-additive finals like AVG."""
+        eng = self._engine(skewed)
+        conn = sqlite_from_data("hc", skewed)
+        sql = (
+            "SELECT k1, AVG(v) FROM hc GROUP BY k1 "
+            "ORDER BY AVG(v) DESC, k1 LIMIT 10"
+        )
+        ctx = parse_query("SET numGroupsLimit = 50; " + sql)
+        got = eng.execute(ctx)
+        exp = conn.execute(sql).fetchall()
+        assert_same_rows(got.rows, exp, ordered=True)
+
+    def test_distributed_sparse_trim(self, skewed):
+        st = StackedTable.build(_schema(), skewed, 8)
+        eng = DistributedEngine()
+        eng.register_table("hc", st)
+        conn = sqlite_from_data("hc", skewed)
+        sql = (
+            "SELECT k1, SUM(v) FROM hc GROUP BY k1 "
+            "ORDER BY SUM(v) DESC, k1 LIMIT 10"
+        )
+        got = eng.query("SET maxDenseGroups = 2; SET numGroupsLimit = 300; " + sql)
+        exp = conn.execute(sql).fetchall()
+        assert_same_rows(got.rows, exp, ordered=True)
+
+
 class TestDistributedSparse:
     @pytest.fixture(scope="class")
     def dist(self, data):

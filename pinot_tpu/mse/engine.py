@@ -734,6 +734,9 @@ class MultiStageEngine:
             key_space=sse_executor._key_space_id(shim),
             group_dims=plan.group_dims,
         )
-        keys, sliced = sse_executor._dense_to_present(shim, presence, partials, ctx.num_groups_limit)
+        keys, sliced = sse_executor._dense_to_present(
+            shim, presence, partials, ctx.num_groups_limit,
+            order_trim=planner_mod.order_by_agg_index(ctx),
+        )
         stats.num_groups = len(keys[0]) if keys else 0
         return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense)

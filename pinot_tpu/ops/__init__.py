@@ -8,6 +8,7 @@ from pinot_tpu.ops.segmented import (  # noqa: F401
     accum_policy,
     fused_group_tables,
     sum_limb_plan,
+    sum_limb_plan64,
     group_count,
     group_max,
     group_min,

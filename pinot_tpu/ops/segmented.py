@@ -66,6 +66,17 @@ def accum_policy() -> str:
     return "wide" if jax.default_backend() == "cpu" else "chunked32"
 
 
+@functools.lru_cache(maxsize=None)
+def _i64_low_half_index() -> int:
+    """Which minor index of bitcast_convert_type(i64 -> u32) holds the LOW
+    32 bits (XLA leaves the order to the backend; probe once per process)."""
+    with jax.ensure_compile_time_eval():  # callable from inside a jit trace
+        halves = np.asarray(
+            jax.lax.bitcast_convert_type(jnp.asarray([1], jnp.int64), jnp.uint32)
+        )
+    return 0 if halves[0, 0] == 1 else 1
+
+
 def _i32(codes):
     return codes.astype(jnp.int32)
 
@@ -190,14 +201,59 @@ def sum_limb_plan(vmin, vmax) -> Tuple[int, bool]:
     return 4, vmin < 0
 
 
+def sum_limb_plan64(vmin, vmax) -> int:
+    """Limb count for the SIGNED-MAGNITUDE 8-bit decomposition of int64
+    values in [vmin, vmax] (the "int64_sum" fused kind).  Unlike the int32
+    two's-complement plan there is no sign-correction limb — the sign rides
+    each limb — so the count is just ceil(bits(max |v|) / 8)."""
+    if vmin is None or vmax is None:
+        return 8
+    m = max(abs(int(vmin)), abs(int(vmax)))
+    for k in range(1, 8):
+        if m < (1 << (8 * k)):
+            return k
+    return 8
+
+
+def _int64_signed_limbs(values, mask, n_limbs: int, dt):
+    """Signed-magnitude 8-bit limb columns + scales for int64 values.
+
+    Two's-complement limbs would recombine through a -2^64 * negcount
+    correction whose f64 cancellation is catastrophic (a column of -1s
+    yields n*(2^64 - 1) - n*2^64, which rounds to 0 long before 2^53);
+    sign-magnitude limbs keep every recombine partial sum bounded by
+    sum(|v| mod 2^(8k)) <= sum(|v|), so the ascending-scale f64 recombine
+    is BIT-exact while sum(|v|) < 2^53 — the reference's double-accumulate
+    contract (SumAggregationFunction.java).  Every row-axis op is 32-bit:
+    the i64 column is bitcast to uint32 halves, |v| is computed with a
+    one-bit carry (~v + 1 carries iff lo == 0), and each limb (<= 255,
+    exact in bf16) is signed by the row's sign."""
+    vm = jnp.where(mask, values, jnp.int64(0))
+    halves = lax.bitcast_convert_type(vm, jnp.uint32)  # [n, 2]
+    lo_ix = _i64_low_half_index()
+    lo = halves[..., lo_ix]
+    hi = halves[..., 1 - lo_ix]
+    neg = hi >= np.uint32(1 << 31)
+    alo = jnp.where(neg, ~lo + np.uint32(1), lo)
+    ahi = jnp.where(neg, ~hi + (lo == np.uint32(0)).astype(jnp.uint32), hi)
+    sgn = jnp.where(neg, np.int32(-1), np.int32(1))
+    cols, scales = [], []
+    for k in range(n_limbs):
+        h = alo if k < 4 else ahi
+        limb = ((h >> np.uint32(8 * (k % 4))) & np.uint32(0xFF)).astype(jnp.int32)
+        cols.append((limb * sgn).astype(dt))
+        scales.append(float(1 << (8 * k)))
+    return cols, scales
+
+
 # entry kinds understood by fused_group_tables
-FUSED_KINDS = ("count", "int_sum", "f32_sum", "f32_sumsq")
+FUSED_KINDS = ("count", "int_sum", "int64_sum", "f32_sum", "f32_sumsq")
 
 
 def _entry_fallback(kind, values, mask, codes, num_groups):
     if kind == "count":
         return group_count(mask, codes, num_groups)
-    if kind == "int_sum" or kind == "f32_sum":
+    if kind in ("int_sum", "int64_sum", "f32_sum"):
         return group_sum(values, mask, codes, num_groups)
     return group_sum_sq(values, mask, codes, num_groups)
 
@@ -214,6 +270,8 @@ def _entry_width(kind, limb_plan) -> int:
     if kind == "int_sum":
         n_limbs, signed = limb_plan if limb_plan is not None else (4, True)
         return n_limbs + (1 if signed else 0)
+    if kind == "int64_sum":
+        return limb_plan if limb_plan is not None else 8
     return 1
 
 
@@ -300,6 +358,8 @@ def _entry_limbs(kind, values, mask, limb_plan, dt):
             cols.append((vm < 0).astype(dt))
             scales.append(-float(1 << (8 * n_limbs)))
         return cols, scales
+    if kind == "int64_sum":
+        return _int64_signed_limbs(values, mask, limb_plan if limb_plan is not None else 8, dt)
     if kind == "f32_sum":
         return [jnp.where(mask, values.astype(jnp.float32), np.float32(0.0))], [1.0]
     v = values.astype(jnp.float32)
@@ -377,12 +437,19 @@ def fused_group_tables(entries, codes, num_groups: int):
 def group_sum(values, mask, codes, num_groups: int):
     """f64[num_groups] sum of values where mask, by group code."""
     codes = _i32(codes)
+    is_int = jnp.issubdtype(values.dtype, jnp.integer)
     if accum_policy() == "wide":
         v = jnp.where(mask, values.astype(jnp.float64), 0.0)
         return _scatter_add(jnp.zeros((num_groups,), jnp.float64), codes, v)
     if num_groups > _MATMUL_MAX_GROUPS:
+        if is_int and values.dtype.itemsize > 4:
+            # exact-below-2^53 f64 scatter (matches the sparse path and the
+            # reference's double accumulate); this path is scatter-bound
+            # already, so the emulated-f64 adds cost little extra
+            v = jnp.where(mask, values.astype(jnp.float64), 0.0)
+            return _scatter_add(jnp.zeros((num_groups,), jnp.float64), codes, v)
         return _scatter_group_sum_f32(values, mask, codes, num_groups)
-    if jnp.issubdtype(values.dtype, jnp.integer) and values.dtype.itemsize <= 4:
+    if is_int and values.dtype.itemsize <= 4:
         # exact limb path (int32 and narrower)
         vm = jnp.where(mask, values, np.int32(0)).astype(jnp.int32)
         u = vm.astype(jnp.uint32)
@@ -391,6 +458,10 @@ def group_sum(values, mask, codes, num_groups: int):
         stacked = jnp.stack(limbs, axis=1)
         scales = [float(1 << (8 * i)) for i in range(4)] + [-float(1 << 32)]
         return _matmul_group_table(stacked, scales, codes, num_groups)
+    if is_int:
+        # exact signed-magnitude limb path for int64 (see _int64_signed_limbs)
+        cols, scales = _int64_signed_limbs(values, mask, 8, jnp.bfloat16)
+        return _matmul_group_table(jnp.stack(cols, axis=1), scales, codes, num_groups)
     v = jnp.where(mask, values.astype(jnp.float32), np.float32(0.0))
     return _matmul_group_sum_f32(v, codes, num_groups)
 
@@ -475,14 +546,18 @@ def masked_sum(values, mask):
     reduction with an f64 chunk combine (~2^-24 relative error per chunk)."""
     if accum_policy() == "wide":
         return jnp.sum(jnp.where(mask, values.astype(jnp.float64), 0.0))
-    if jnp.issubdtype(values.dtype, jnp.integer) and values.dtype.itemsize <= 4:
+    if jnp.issubdtype(values.dtype, jnp.integer):
         # direct chunked limb reduction (no one-hot needed without groups):
-        # per-chunk per-limb f32 sums <= 255 * _CHUNK < 2^24 are exact.
-        vm = jnp.where(mask, values, np.int32(0)).astype(jnp.int32)
-        u = vm.astype(jnp.uint32)
-        limbs = [((u >> np.uint32(8 * i)) & np.uint32(0xFF)).astype(jnp.float32) for i in range(4)]
-        limbs.append((vm < 0).astype(jnp.float32))  # two's-complement correction
-        scales = [float(1 << (8 * i)) for i in range(4)] + [-float(1 << 32)]
+        # per-chunk per-limb f32 sums, |sum| <= 255 * _CHUNK < 2^24, exact.
+        if values.dtype.itemsize <= 4:
+            vm = jnp.where(mask, values, np.int32(0)).astype(jnp.int32)
+            u = vm.astype(jnp.uint32)
+            limbs = [((u >> np.uint32(8 * i)) & np.uint32(0xFF)).astype(jnp.float32) for i in range(4)]
+            limbs.append((vm < 0).astype(jnp.float32))  # two's-complement correction
+            scales = [float(1 << (8 * i)) for i in range(4)] + [-float(1 << 32)]
+        else:
+            # int64: signed-magnitude limbs (exact while sum(|v|) < 2^53)
+            limbs, scales = _int64_signed_limbs(values, mask, 8, jnp.float32)
         stacked = jnp.stack(limbs, axis=1)
         (stacked,) = _pad_to_chunks(stacked)
         chunk_sums = stacked.reshape(-1, _CHUNK, len(limbs)).sum(axis=1)

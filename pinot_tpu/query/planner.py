@@ -23,7 +23,6 @@ replaced by a Pallas hash table.
 """
 from __future__ import annotations
 
-import warnings
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -598,21 +597,13 @@ def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges):
                 if is_int and v.dtype.itemsize <= 4:
                     lp = ops.sum_limb_plan(*rng) if rng is not None else (4, True)
                     fmap[field] = ("fused", entry_slot("int_sum", v, mask, lp))
+                elif is_int:
+                    # wide-range int64: signed-magnitude limb decomposition,
+                    # bit-exact while sum(|v|) < 2^53 — the reference's
+                    # double-accumulate contract (SumAggregationFunction)
+                    nl = ops.sum_limb_plan64(*rng) if rng is not None else 8
+                    fmap[field] = ("fused", entry_slot("int64_sum", v, mask, nl))
                 else:
-                    if is_int:
-                        # the reference accumulates long sums in double
-                        # (exact < 2^53); f32 accumulation is ~2^-24 relative
-                        hint = (
-                            "add column stats bounding the range to int32 for "
-                            "an exact path"
-                            if rng is None
-                            else "value range exceeds int32; no exact path exists"
-                        )
-                        warnings.warn(
-                            "grouped SUM over wide-range int64 column falls back "
-                            f"to f32 accumulation (~2^-24 relative error); {hint}",
-                            stacklevel=2,
-                        )
                     fmap[field] = ("fused", entry_slot("f32_sum", vals, mask))
             elif kind == "sumsq":
                 fmap[field] = ("fused", entry_slot("f32_sumsq", vals, mask))
@@ -772,18 +763,28 @@ def sparse_grouped_tables(aggs, inputs, tmask, key, num_slots: int, order_spec=N
             # sov carries -v for max, so one more flip restores the sign
             if omode == "max":
                 group_ov = -group_ov
-            # NULL order values rank LAST in every direction (matching the
-            # host-side _order_trim_select NaN handling); clamp keeps them
-            # FINITE so the finite check below still marks the group
-            # rankable instead of dropping it (review-caught)
-            group_ov = jnp.clip(jnp.where(empty, jnp.inf, group_ov), -1e300, 1e300)
+            # NULL (empty) and NaN order values rank LAST in every direction
+            # (matching the host-side _order_trim_select NaN handling); clamp
+            # keeps them FINITE so the finite check below still marks the
+            # group rankable instead of dropping it (review-caught).  An
+            # all-NaN group's start-row sov is NaN (NaN sorts last), which
+            # would otherwise survive clip as NaN and drop the group.
+            group_ov = jnp.clip(
+                jnp.where(empty | jnp.isnan(group_ov), jnp.inf, group_ov), -1e300, 1e300
+            )
         else:
             ov_raw, om = inputs[oi]
+            isn = None
             if omode == "count":
                 c = om.astype(jnp.float64)
             else:
                 v = ov_raw if getattr(ov_raw, "ndim", 0) else jnp.broadcast_to(ov_raw, (n,))
-                c = jnp.where(om, v.astype(jnp.float64), 0.0)
+                cv = v.astype(jnp.float64)
+                # NaN rows are excluded from the cumsum (one NaN would poison
+                # the prefix sums of every later-keyed group) and tracked per
+                # group instead; NaN-sum groups rank last like the host path
+                isn = jnp.isnan(cv)
+                c = jnp.where(om & ~isn, cv, 0.0)
             cp = c[perm]
             s0 = jnp.concatenate([jnp.zeros((1,), jnp.float64), jnp.cumsum(cp)])
             # smallest start index >= i, from the right; strict next start
@@ -797,8 +798,15 @@ def sparse_grouped_tables(aggs, inputs, tmask, key, num_slots: int, order_spec=N
                 # mask the same way and send empty groups to rank-last
                 mp = om.astype(jnp.float64)[perm]
                 m0 = jnp.concatenate([jnp.zeros((1,), jnp.float64), jnp.cumsum(mp)])
+                np_ = (isn & om).astype(jnp.float64)[perm]
+                n0 = jnp.concatenate([jnp.zeros((1,), jnp.float64), jnp.cumsum(np_)])
+                # rank-last when the group saw a NaN value, when the prefix
+                # sums overflowed to inf (inf - inf = NaN), or when no
+                # agg-mask rows contributed (SQL NULL)
+                bad = ((n0[nxt] - n0[iota]) > 0) | jnp.isnan(group_ov)
                 group_ov = jnp.clip(
-                    jnp.where((m0[nxt] - m0[iota]) > 0, group_ov, jnp.inf), -1e300, 1e300
+                    jnp.where(bad | ((m0[nxt] - m0[iota]) <= 0), jnp.inf, group_ov),
+                    -1e300, 1e300,
                 )
         ovkey = jnp.where(is_start, group_ov, jnp.inf)
         sovk, sskey, sseg = lax.sort((ovkey, skey, seg), num_keys=2)

@@ -140,6 +140,54 @@ def test_join_scalar_agg(engines, strategy):
     assert int(res.rows[0][1]) == int(exp[1])
 
 
+def test_join_groupby_order_trim_keeps_true_top(engines):
+    """numGroupsLimit trim on the join group-by path must rank by the ORDER
+    BY comparator (TableResizer analog), not lowest packed keys — the
+    revenue skew below puts every true top group at HIGH d_datekey values
+    (review-caught: the join path still used the lowest-key trim)."""
+    eng, lineorder, dates = engines
+    # d_datekey grows with index, and revenue correlates with the key, so
+    # the lowest-key trim would keep exactly the WRONG groups
+    rev = np.asarray(lineorder["lo_revenue"])
+    od = np.asarray(lineorder["lo_orderdate"])
+    skewed = dict(lineorder)
+    skewed["lo_revenue"] = rev + (od - od.min()).astype(np.int64) * 1000
+    eng2 = DistributedEngine()
+    lo_schema = Schema(
+        name="lineorder",
+        fields=[
+            FieldSpec("lo_orderdate", DataType.INT),
+            FieldSpec("lo_revenue", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("lo_discount", DataType.INT, role=FieldRole.METRIC),
+            FieldSpec("lo_region", DataType.STRING),
+        ],
+    )
+    date_schema = Schema(
+        name="dates",
+        fields=[
+            FieldSpec("d_datekey", DataType.INT),
+            FieldSpec("d_year", DataType.INT),
+            FieldSpec("d_month", DataType.INT),
+        ],
+    )
+    eng2.register_table("lineorder", StackedTable.build(lo_schema, skewed, eng2.num_devices))
+    eng2.register_table("dates", StackedTable.build(date_schema, dates, eng2.num_devices))
+    sql = (
+        "SELECT d_datekey, SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey "
+        "GROUP BY d_datekey ORDER BY SUM(lo_revenue) DESC, d_datekey LIMIT 10"
+    )
+    res = eng2.query("SET numGroupsLimit = 40; " + sql)
+    exp = sqlite_rows(
+        skewed, dates,
+        "SELECT d_datekey, SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey "
+        "GROUP BY d_datekey ORDER BY SUM(lo_revenue) DESC, d_datekey LIMIT 10",
+    )
+    got = [(int(r[0]), int(r[1])) for r in res.rows]
+    assert got == [(int(a), int(b)) for a, b in exp]
+
+
 def test_join_groupby_mixed_fact_dim(engines):
     """Group keys from both sides of the join."""
     eng, lineorder, dates = engines

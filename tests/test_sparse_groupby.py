@@ -198,6 +198,28 @@ class TestOrderByAwareTrim:
         exp = eng.query(sql)
         assert_same_rows(got.rows, exp.rows, ordered=True)
 
+    def test_nan_order_value_does_not_poison_trim(self):
+        """A computed-NaN order value (0/0 with the agg mask still TRUE) must
+        rank its own group last WITHOUT poisoning the prefix sums of every
+        later-keyed group (review-caught: one NaN in the cumsum dropped all
+        groups sorting after it)."""
+        rng = np.random.default_rng(21)
+        n = 9_000
+        k = rng.integers(0, 300, n).astype(np.int32)
+        v = rng.integers(1, 50, n).astype(np.int64)
+        w = rng.random(n) + 0.5
+        nanrows = k == 5
+        v[nanrows] = 0
+        w[nanrows] = 0.0  # v / w = 0 / 0 -> NaN, agg mask true
+        data = {"k1": k, "k2": np.zeros(n, np.int32), "v": v, "w": w}
+        eng = self._engine(data)
+        sql = "SELECT k1, SUM(v / w) AS s FROM hc GROUP BY k1 ORDER BY s DESC, k1 LIMIT 10"
+        got = eng.execute(
+            parse_query("SET maxDenseGroups = 2; SET numGroupsLimit = 40; " + sql)
+        )
+        exp = eng.query(sql)  # untrimmed dense path: ground truth
+        assert_same_rows(got.rows, exp.rows, ordered=True)
+
     def test_dense_trim_keeps_true_top(self, skewed):
         """Dense-path numGroupsLimit trim ranks by the comparator too —
         including non-additive finals like AVG."""

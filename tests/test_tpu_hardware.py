@@ -61,7 +61,34 @@ exp_b = np.unpackbits(words_np.view(np.uint8), bitorder="little").astype(bool)
 assert np.array_equal(got_b, exp_b), "bitmap unpack mismatch"
 out["bitmap_unpack_exact"] = True
 
-# 4. sparse group-by sort kernel: tables match a host groupby
+# 4. wide-range int64 grouped SUM: signed-magnitude limb path bit-exact
+# (|v| < 2^35 exceeds int32 but keeps sum(|v|) < 2^53 over 200k rows)
+vals64_np = rng.integers(-(1 << 35), 1 << 35, n, dtype=np.int64)
+vals64 = jnp.asarray(vals64_np)
+got64 = np.asarray(jax.device_get(jax.jit(lambda v, m, c: ops.group_sum(v, m, c, G))(vals64, mask, codes)))
+exp64 = np.zeros(G, dtype=np.int64)
+np.add.at(exp64, np.asarray(codes), np.where(np.asarray(mask), vals64_np, 0))
+assert np.array_equal(got64.astype(np.int64), exp64), "wide int64 grouped SUM not exact"
+out["group_sum64_exact"] = True
+
+# 4b. same through the fused scan, and the scalar masked_sum
+[t64] = jax.device_get(jax.jit(
+    lambda v, m, c: ops.fused_group_tables([("int64_sum", v, m, 8)], c, G)
+)(vals64, mask, codes))
+assert np.array_equal(np.asarray(t64).astype(np.int64), exp64), "fused int64 SUM not exact"
+got64_s = float(jax.device_get(jax.jit(ops.masked_sum)(vals64, mask)))
+assert got64_s == float(np.where(np.asarray(mask), vals64_np, 0).sum()), "masked int64 SUM not exact"
+out["fused_sum64_exact"] = True
+
+# 4c. the two's-complement catastrophe guard: a column of -1s
+neg1 = jnp.full((n,), -1, jnp.int64)
+gneg = np.asarray(jax.device_get(jax.jit(lambda v, c: ops.group_sum(v, jnp.ones((n,), bool), c, G))(neg1, codes)))
+expneg = np.zeros(G, dtype=np.int64)
+np.add.at(expneg, np.asarray(codes), -1)
+assert np.array_equal(gneg.astype(np.int64), expneg), "all -1 int64 SUM not exact"
+out["sum64_neg_exact"] = True
+
+# 5. sparse group-by sort kernel: tables match a host groupby
 key_np = rng.integers(0, 5000, n).astype(np.int64)
 sum_fn = get_agg_function("sum")
 def sparse(vals, mask, key):
@@ -116,5 +143,8 @@ def test_kernel_exactness_on_accelerator(accelerator):
         "group_sum_exact": True,
         "masked_sum_exact": True,
         "bitmap_unpack_exact": True,
+        "group_sum64_exact": True,
+        "fused_sum64_exact": True,
+        "sum64_neg_exact": True,
         "sparse_groupby_exact": True,
     }

@@ -248,30 +248,60 @@ class OrderByExpr:
 @dataclass(frozen=True)
 class WindowSpec:
     """One window-function select item — fn(...) OVER (PARTITION BY ...
-    ORDER BY ...) (reference: WindowAggregateOperator,
-    pinot-query-runtime/.../runtime/operator/WindowAggregateOperator.java).
+    ORDER BY ... [ROWS|RANGE frame]) (reference: WindowAggregateOperator,
+    pinot-query-runtime/.../runtime/operator/WindowAggregateOperator.java,
+    value functions under .../operator/window/value/, frames per
+    WindowFrame.java).
 
-    Frames are the whole partition (no ROWS BETWEEN) — ROW_NUMBER/RANK/
-    DENSE_RANK rank within the ordered partition; SUM/COUNT/AVG/MIN/MAX
-    aggregate the full partition.  Documented delta: running-frame variants
-    are unsupported."""
+    Functions: row_number/rank/dense_rank/ntile (ranking), lag/lead/
+    first_value/last_value (value), sum/count/avg/min/max/bool_and/bool_or
+    (aggregate).  literal_args carries NTILE's bucket count and LAG/LEAD's
+    (offset, default)."""
 
-    function: str  # row_number | rank | dense_rank | sum | count | avg | min | max
+    function: str
     expr: Optional[Expr]
     partition_by: Tuple[Expr, ...] = ()
     order_by: Tuple[OrderByExpr, ...] = ()
-    # "range_all" = whole partition; "rows_cumulative" = ROWS BETWEEN
-    # UNBOUNDED PRECEDING AND CURRENT ROW (running aggregate)
+    # "range_all" = no frame clause (standard default: whole partition, or
+    # RANGE UNBOUNDED PRECEDING..CURRENT ROW when ORDER BY is present);
+    # "rows"/"range" = explicit frame with signed bounds; "rows_cumulative"
+    # = legacy alias for rows(None, 0)
     frame: str = "range_all"
+    # signed bound offsets: None = UNBOUNDED, 0 = CURRENT ROW, -k = k
+    # PRECEDING, +k = k FOLLOWING (ROWS: row counts; RANGE: order-key deltas)
+    frame_lo: Optional[float] = None
+    frame_hi: Optional[float] = None
+    literal_args: Tuple = ()
 
     def fingerprint(self) -> str:
         e = self.expr.fingerprint() if self.expr else "*"
         p = "|".join(x.fingerprint() for x in self.partition_by)
         o = "|".join(f"{x.expr.fingerprint()}:{x.ascending}" for x in self.order_by)
-        return f"win:{self.function}({e})p[{p}]o[{o}]f[{self.frame}]"
+        f = f"{self.frame}:{self.frame_lo}:{self.frame_hi}"
+        la = ",".join(repr(a) for a in self.literal_args)
+        return f"win:{self.function}({e};{la})p[{p}]o[{o}]f[{f}]"
 
     def __str__(self) -> str:
         return f"{self.function}() OVER (...)"
+
+
+@dataclass(frozen=True)
+class GapfillSpec:
+    """GAPFILL(time_expr, start, end, step [, FILL(target, 'mode')...
+    [, TIMESERIESON(key...)]]) — post-reduce time-bucket gap filling
+    (reference: pinot-core/.../core/query/reduce/GapfillProcessor.java,
+    SumAvgGapfillProcessor.java, GapfillUtils fill modes).
+
+    Buckets [start, end) stepping by step are emitted for every observed
+    series (the TIMESERIESON key combination); missing cells fill per mode:
+    FILL_PREVIOUS_VALUE carries the series' last seen value, default NULL."""
+
+    time_expr: Expr
+    start: int
+    end: int
+    step: int
+    fills: Tuple[Tuple[Expr, str], ...] = ()  # (target, FILL_* mode)
+    series: Tuple[Expr, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -335,6 +365,8 @@ class QueryContext:
     # set operations chained onto this query: (op, all_flag, rhs ctx) with
     # op in {"union", "intersect", "except"} (MSE SetOperator analog)
     set_ops: List[tuple] = dc_field(default_factory=list)
+    # time-bucket gap filling applied post-reduce (GapfillProcessor analog)
+    gapfill: Optional[GapfillSpec] = None
 
     @property
     def aggregations(self) -> List[AggregationSpec]:

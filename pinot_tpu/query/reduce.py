@@ -178,8 +178,113 @@ def _reduce_groupby(ctx: QueryContext, results: List[GroupBySegmentResult], stat
         out_cols.append(_eval_env_expr(s, env, n) if isinstance(s, Expr) else env[s.fingerprint()])
 
     rows = _rows_from_columns(out_cols, n)
-    rows = _order_and_trim(ctx, rows, [s.fingerprint() for s in ctx.select_list], env, n)
+    if ctx.gapfill is not None:
+        rows = _apply_gapfill(ctx, rows)
+        if ctx.order_by:
+            rows = _order_rows_by_select(ctx, rows)
+        rows = rows[ctx.offset: ctx.offset + ctx.limit]
+    else:
+        rows = _order_and_trim(ctx, rows, [s.fingerprint() for s in ctx.select_list], env, n)
     return ResultTable(columns=ctx.column_names_out(), rows=rows, stats=stats)
+
+
+def _gapfill_select_pos(ctx, e) -> int:
+    """Resolve a GAPFILL argument expression to its select-list position
+    (by fingerprint, then by alias name)."""
+    fps = [s.fingerprint() for s in ctx.select_list]
+    fp = e.fingerprint()
+    if fp in fps:
+        return fps.index(fp)
+    if e.is_column and e.op in ctx.select_aliases:
+        return ctx.select_aliases.index(e.op)
+    # plain-call form of a selected aggregation: FILL(SUM(v), ...)
+    for i, s in enumerate(ctx.select_list):
+        if isinstance(s, AggregationSpec) and s.filter is None:
+            args = ([s.expr] if s.expr is not None else []) + [Expr.lit(a) for a in s.literal_args]
+            if Expr.call(s.function, *args).fingerprint() == fp:
+                return i
+            if s.expr is None and not s.literal_args and (
+                Expr.call(s.function, Expr.col("*")).fingerprint() == fp
+            ):
+                return i
+    raise ValueError(f"GAPFILL references {e}, which is not in the select list")
+
+
+def _apply_gapfill(ctx, rows: List[tuple]) -> List[tuple]:
+    """Time-bucket gap filling over reduced group-by rows — the
+    GapfillProcessor contract (pinot-core/.../core/query/reduce/
+    GapfillProcessor.java): emit every bucket in [start, end) stepping by
+    step for every observed TIMESERIESON key combination; missing cells
+    fill per FILL mode (FILL_PREVIOUS_VALUE carries the series' last seen
+    value; default NULL).  Buckets outside the range are dropped."""
+    gf = ctx.gapfill
+    tpos = _gapfill_select_pos(ctx, gf.time_expr)
+    spos = [_gapfill_select_pos(ctx, s) for s in gf.series]
+    fill_modes = {_gapfill_select_pos(ctx, t): mode for t, mode in gf.fills}
+    ncol = len(ctx.select_list)
+    cell: Dict[tuple, tuple] = {}
+    series_seen: List[tuple] = []
+    sset = set()
+    for r in rows:
+        b = r[tpos]
+        if b is None:
+            continue
+        b = int(b)
+        sk = tuple(r[i] for i in spos)
+        if sk not in sset:
+            sset.add(sk)
+            series_seen.append(sk)
+        if gf.start <= b < gf.end and (b - gf.start) % gf.step == 0:
+            cell[(b, sk)] = r
+    if not series_seen:
+        series_seen = [()] if not spos else []
+    # FILL_DEFAULT_VALUE fills the column's TYPE default (0 for numeric, ""
+    # for strings — GapfillUtils.getDefaultValue), inferred from observed
+    # values; columns without a FILL spec stay NULL
+    defaults: Dict[int, Any] = {}
+    for i, mode in fill_modes.items():
+        if mode != "FILL_DEFAULT_VALUE":
+            continue
+        defaults[i] = 0
+        for r in rows:
+            if r[i] is not None:
+                defaults[i] = "" if isinstance(r[i], str) else 0
+                break
+    prev: Dict[tuple, Dict[int, Any]] = {sk: {} for sk in series_seen}
+    out: List[tuple] = []
+    for b in range(gf.start, gf.end, gf.step):
+        for sk in series_seen:
+            r = cell.get((b, sk))
+            if r is not None:
+                out.append(tuple(b if i == tpos else v for i, v in enumerate(r)))
+                for i in range(ncol):
+                    prev[sk][i] = r[i]
+            else:
+                vals = []
+                for i in range(ncol):
+                    if i == tpos:
+                        vals.append(b)
+                    elif i in spos:
+                        vals.append(sk[spos.index(i)])
+                    elif fill_modes.get(i) == "FILL_PREVIOUS_VALUE":
+                        vals.append(prev[sk].get(i))
+                    elif i in defaults:
+                        vals.append(defaults[i])
+                    else:
+                        vals.append(None)
+                out.append(tuple(vals))
+    return out
+
+
+def _order_rows_by_select(ctx, rows: List[tuple]) -> List[tuple]:
+    """ORDER BY over already-materialized rows (post-gapfill): each order
+    expression must resolve to a select-list position."""
+    ord_vals = []
+    for ob in ctx.order_by:
+        p = _gapfill_select_pos(ctx, ob.expr)
+        ord_vals.append(np.asarray([r[p] for r in rows], dtype=object))
+    order = _sorted_order(ctx.order_by, ord_vals, len(rows))
+    return [rows[i] for i in order]
 
 
 def _ident_like(field: str, arr: np.ndarray):
@@ -263,12 +368,44 @@ def _reduce_selection(ctx: QueryContext, results: List[SelectionSegmentResult], 
     return ResultTable(columns=out_names, rows=rows, stats=stats)
 
 
+def _win_lex_key(vals, asc: bool) -> Tuple[np.ndarray, bool]:
+    """(sortable float key, is_numeric) for one OVER(ORDER BY) expression:
+    numeric values rank numerically, genuine strings by sorted-unique codes.
+    Descending flips sign, so 'preceding' is always toward SMALLER keys —
+    which makes signed RANGE offsets direction-agnostic.  RANGE offset
+    frames are only legal over a numeric key (the caller checks the flag)."""
+    a = np.asarray(vals)
+    if a.dtype == object:
+        try:
+            a = a.astype(np.float64)
+        except (ValueError, TypeError):
+            pass
+    if np.issubdtype(a.dtype, np.number):
+        a = a.astype(np.float64)
+        return (a if asc else -a), True
+    _, inv = np.unique(a.astype(str), return_inverse=True)
+    inv = inv.astype(np.float64)
+    return (inv if asc else -inv), False
+
+
+_WIN_AGG_FNS = ("sum", "avg", "count", "min", "max", "bool_and", "bool_or")
+
+
 def _compute_window(spec, arrays: Dict[str, np.ndarray], n: int) -> np.ndarray:
     """One window function over the merged result rows.
 
-    Partition ids by hashing the partition-key tuples; within each
-    partition, rows order by the OVER(ORDER BY ...) keys (stable).  Frames
-    are the whole partition (ir.WindowSpec contract)."""
+    Reference parity: WindowAggregateOperator + the window/value family
+    (pinot-query-runtime/.../runtime/operator/window/value/
+    LagValueWindowFunction.java, LeadValueWindowFunction.java,
+    FirstValueWindowFunction.java, LastValueWindowFunction.java,
+    range/NtileWindowFunction.java) with ROWS/RANGE frames per
+    WindowFrame.java.
+
+    Partition ids hash the partition-key tuples; within each partition rows
+    order by the OVER(ORDER BY ...) keys (stable).  Every frame shape
+    reduces to per-row inclusive-exclusive bounds [ws, we) in sorted space;
+    sums/counts then resolve via prefix sums, min/max via prefix/suffix
+    accumulation (unbounded edge) or per-row slices (bounded frames)."""
     pid = np.zeros(n, dtype=np.int64)
     if spec.partition_by:
         pkeys = [np.asarray(arrays[f"__wx_{p.fingerprint()}"]) for p in spec.partition_by]
@@ -276,113 +413,174 @@ def _compute_window(spec, arrays: Dict[str, np.ndarray], n: int) -> np.ndarray:
         for i in range(n):
             key = tuple(k[i] for k in pkeys)
             pid[i] = seen.setdefault(key, len(seen))
-    okeys = [(np.asarray(arrays[f"__wx_{o.expr.fingerprint()}"]), o.ascending) for o in spec.order_by]
-    arg = np.asarray(arrays[f"__wx_{spec.expr.fingerprint()}"], dtype=np.float64) if spec.expr is not None else None
-
     fn = spec.function
-    out = np.zeros(n, dtype=np.float64)
-    if fn in ("row_number", "rank", "dense_rank"):
-        # global stable sort by (pid, order keys) then rank within partition
-        lex: List[np.ndarray] = [pid]
-        for vals, asc in okeys:
-            # merged selection arrays are object-dtype; numeric values must
-            # rank numerically, genuine strings by sorted-unique codes
-            a = np.asarray(vals)
-            if a.dtype == object:
-                try:
-                    a = a.astype(np.float64)
-                except (ValueError, TypeError):
-                    pass
-            if np.issubdtype(a.dtype, np.number):
-                a = a.astype(np.float64)
-                lex.append(a if asc else -a)
-            else:
-                u, inv = np.unique(a.astype(str), return_inverse=True)
-                lex.append(inv if asc else -inv)
-        order = np.lexsort(tuple(reversed(lex)))
-        prev_pid = None
-        pos = rank = dense = 0
-        prev_key = None
-        for idx in order:
-            key = tuple(np.asarray(l)[idx] for l in lex[1:])
-            if pid[idx] != prev_pid:
-                prev_pid = pid[idx]
-                pos = rank = dense = 1
-                prev_key = key
-            else:
-                pos += 1
-                if key != prev_key:
-                    rank = pos
-                    dense += 1
-                    prev_key = key
-            out[idx] = pos if fn == "row_number" else (rank if fn == "rank" else dense)
-        return out.astype(np.int64)
-    if spec.frame == "rows_cumulative":
-        return _running_window(fn, pid, okeys, arg, n)
-    # whole-partition aggregates
-    nparts = int(pid.max()) + 1 if n else 0
-    if fn == "count":
-        cnt = np.bincount(pid, minlength=nparts)
-        return cnt[pid].astype(np.int64)
-    if arg is None:
-        raise ValueError(f"window {fn} needs an argument")
-    if fn in ("sum", "avg"):
-        s = np.bincount(pid, weights=arg, minlength=nparts)
-        if fn == "sum":
-            return s[pid]
-        cnt = np.bincount(pid, minlength=nparts)
-        return (s / cnt)[pid]
-    ident = np.inf if fn == "min" else -np.inf
-    acc = np.full(nparts, ident)
-    (np.minimum if fn == "min" else np.maximum).at(acc, pid, arg)
-    return acc[pid]
-
-
-def _running_window(fn: str, pid: np.ndarray, okeys, arg, n: int) -> np.ndarray:
-    """ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW: sort within
-    partitions by the OVER(ORDER BY) keys and accumulate (running
-    aggregate).  Vectorized via segment-reset cumulative sums."""
-    lex: List[np.ndarray] = [pid]
-    for vals, asc in okeys:
-        a = np.asarray(vals)
-        if a.dtype == object:
-            try:
-                a = a.astype(np.float64)
-            except (ValueError, TypeError):
-                pass
-        if np.issubdtype(a.dtype, np.number):
-            lex.append(a.astype(np.float64) if asc else -a.astype(np.float64))
-        else:
-            _, inv = np.unique(a.astype(str), return_inverse=True)
-            lex.append(inv if asc else -inv)
-    order = np.lexsort(tuple(reversed(lex)))
+    keyed = [_win_lex_key(arrays[f"__wx_{o.expr.fingerprint()}"], o.ascending) for o in spec.order_by]
+    lex = [k for k, _ in keyed]
+    lex_numeric = [num for _, num in keyed]
+    order = np.lexsort(tuple(reversed([pid] + lex)))
     spid = pid[order]
+    idx = np.arange(n)
     starts = np.ones(n, dtype=bool)
-    starts[1:] = spid[1:] != spid[:-1]
-    start_idx = np.maximum.accumulate(np.where(starts, np.arange(n), 0))
-    out_sorted = np.empty(n, dtype=np.float64)
+    if n > 1:
+        starts[1:] = spid[1:] != spid[:-1]
+    # partition bounds per sorted row: [start_idx, end_idx)
+    ps = idx[starts]
+    pe = np.append(ps[1:], n)
+    pnum = np.cumsum(starts) - 1
+    start_idx = ps[pnum] if n else idx
+    end_idx = pe[pnum] if n else idx
+    pos0 = idx - start_idx
+    plen = end_idx - start_idx
+    # peer groups: rows with equal ORDER BY keys (frame CURRENT ROW in RANGE
+    # mode, and rank/dense_rank steps)
+    peer_flags = starts.copy()
+    if lex and n > 1:
+        diff = np.zeros(n - 1, dtype=bool)
+        for k in lex:
+            a = np.asarray(k)[order]
+            diff |= ~((a[1:] == a[:-1]) | (np.isnan(a[1:]) & np.isnan(a[:-1])))
+        peer_flags[1:] |= diff
+    pps = idx[peer_flags]
+    ppe = np.append(pps[1:], n)
+    ppnum = np.cumsum(peer_flags) - 1
+    peer_start = pps[ppnum] if n else idx
+    peer_end = ppe[ppnum] if n else idx
+
+    def unsort(sorted_vals, dtype):
+        out = np.empty(n, dtype=dtype)
+        out[order] = sorted_vals
+        return out
+
+    # -- ranking functions (frames do not apply) ------------------------
+    if fn in ("row_number", "rank", "dense_rank", "ntile"):
+        if fn == "row_number":
+            r = pos0 + 1
+        elif fn == "rank":
+            r = peer_start - start_idx + 1
+        elif fn == "dense_rank":
+            dc = np.cumsum(peer_flags)
+            r = dc - (dc[start_idx] - 1)
+        else:  # NTILE(t): first (plen % t) buckets get one extra row
+            t = int(spec.literal_args[0])
+            q, rem = plen // t, plen % t
+            cut = rem * (q + 1)
+            r = np.where(
+                pos0 < cut,
+                pos0 // np.maximum(q + 1, 1),
+                rem + (pos0 - cut) // np.maximum(q, 1),
+            ) + 1
+        return unsort(r.astype(np.int64), np.int64)
+
+    sval = None
+    if spec.expr is not None:
+        sval = np.asarray(arrays[f"__wx_{spec.expr.fingerprint()}"], dtype=object)[order]
+
+    # -- offset value functions (frames do not apply) -------------------
+    if fn in ("lag", "lead"):
+        off = int(spec.literal_args[0]) if spec.literal_args else 1
+        default = spec.literal_args[1] if len(spec.literal_args) > 1 else None
+        src = idx - off if fn == "lag" else idx + off
+        valid = (src >= start_idx) & (src < end_idx)
+        srcc = np.clip(src, 0, max(n - 1, 0))
+        return unsort(np.where(valid, sval[srcc], default), object)
+
+    # -- frame resolution: [ws, we) per sorted row ----------------------
+    mode, lo, hi = spec.frame, spec.frame_lo, spec.frame_hi
+    if mode == "rows_cumulative":
+        mode, lo, hi = "rows", None, 0
+    elif mode == "range_all":
+        if spec.order_by:
+            # SQL default frame with ORDER BY: RANGE UNBOUNDED PRECEDING ..
+            # CURRENT ROW (cumulative by peer groups)
+            mode, lo, hi = "range", None, 0
+        else:
+            mode, lo, hi = "rows", None, None  # whole partition
+    if mode == "rows":
+        ws = start_idx if lo is None else np.maximum(start_idx, idx + int(lo))
+        we = end_idx if hi is None else np.minimum(end_idx, idx + int(hi) + 1)
+    else:  # range
+        if not lex:
+            ws, we = start_idx, end_idx
+        elif lo in (None, 0) and hi in (None, 0):
+            ws = start_idx if lo is None else peer_start
+            we = end_idx if hi is None else peer_end
+        else:
+            if len(lex) != 1:
+                raise ValueError("RANGE frame with offsets requires exactly one ORDER BY key")
+            if not lex_numeric[0]:
+                raise ValueError("RANGE frame with offsets requires a NUMERIC ORDER BY key")
+            sk = np.asarray(lex[0], np.float64)[order]
+            ws = np.empty(n, dtype=np.int64)
+            we = np.empty(n, dtype=np.int64)
+            for s, e in zip(ps, pe):  # per partition: vectorized searchsorted
+                seg = sk[s:e]
+                if lo is None:
+                    ws[s:e] = s
+                elif lo == 0:
+                    ws[s:e] = peer_start[s:e]
+                else:
+                    ws[s:e] = s + np.searchsorted(seg, seg + float(lo), side="left")
+                if hi is None:
+                    we[s:e] = e
+                elif hi == 0:
+                    we[s:e] = peer_end[s:e]
+                else:
+                    we[s:e] = s + np.searchsorted(seg, seg + float(hi), side="right")
+    wsc = np.minimum(ws, we)  # empty frames collapse to zero-width slices
+
+    if fn == "count" and spec.expr is None:  # COUNT(*): frame row count
+        return unsort(np.maximum(we - ws, 0).astype(np.int64), np.int64)
+    if sval is None:
+        raise ValueError(f"window {fn} needs an argument")
+
+    if fn in ("first_value", "last_value"):
+        valid = we > ws
+        pos = np.clip(np.where(fn == "first_value", wsc, we - 1), 0, max(n - 1, 0))
+        return unsort(np.where(valid, sval[pos], None), object)
+
+    # -- numeric frame aggregates ---------------------------------------
+    v = np.array([np.nan if x is None else float(x) for x in sval], dtype=np.float64)
+    if fn in ("bool_and", "bool_or"):
+        v = np.where(np.isnan(v), np.nan, (v != 0).astype(np.float64))
+    notnan = ~np.isnan(v)
+    cn = np.concatenate([[0], np.cumsum(notnan.astype(np.int64))])
+    m = cn[we] - cn[wsc]  # non-null rows in frame
     if fn == "count":
-        out_sorted = (np.arange(n) - start_idx + 1).astype(np.float64)
+        return unsort(m.astype(np.int64), np.int64)
+    if fn in ("sum", "avg"):
+        cs = np.concatenate([[0.0], np.cumsum(np.where(notnan, v, 0.0))])
+        tot = cs[we] - cs[wsc]
+        out_sorted = np.where(m > 0, tot, np.nan)
+        if fn == "avg":
+            out_sorted = out_sorted / np.maximum(m, 1)
+        return unsort(out_sorted, np.float64)
+    # min/max family: prefix/suffix accumulation when one edge is the
+    # partition bound, per-row slices for doubly-bounded frames
+    is_min = fn in ("min", "bool_and")
+    acc_op = np.fmin if is_min else np.fmax  # fmin/fmax ignore NaN
+    lo_unbounded = bool(np.all(wsc == start_idx))
+    hi_unbounded = bool(np.all(we == end_idx))
+    out_sorted = np.full(n, np.nan)
+    if lo_unbounded:
+        pref = np.empty(n, dtype=np.float64)
+        for i in range(n):
+            pref[i] = v[i] if starts[i] else acc_op(pref[i - 1], v[i])
+        sel = we > wsc
+        out_sorted[sel] = pref[we[sel] - 1]
+    elif hi_unbounded:
+        suf = np.empty(n, dtype=np.float64)
+        for i in range(n - 1, -1, -1):
+            last = (i == n - 1) or starts[i + 1]
+            suf[i] = v[i] if last else acc_op(suf[i + 1], v[i])
+        sel = we > wsc
+        out_sorted[sel] = suf[wsc[sel]]
     else:
-        if arg is None:
-            raise ValueError(f"window {fn} needs an argument")
-        v = np.asarray(arg, dtype=np.float64)[order]
-        if fn in ("sum", "avg"):
-            c = np.cumsum(v)
-            base = np.where(start_idx > 0, c[start_idx - 1], 0.0)
-            run = c - base
-            if fn == "sum":
-                out_sorted = run
-            else:
-                out_sorted = run / (np.arange(n) - start_idx + 1)
-        else:  # running min/max: loop with partition resets
-            best = 0.0
-            for i in range(n):
-                best = v[i] if starts[i] else (min(best, v[i]) if fn == "min" else max(best, v[i]))
-                out_sorted[i] = best
-    out = np.empty(n, dtype=np.float64)
-    out[order] = out_sorted
-    return out
+        for i in range(n):
+            if we[i] > wsc[i] and m[i] > 0:
+                seg = v[wsc[i]: we[i]]
+                out_sorted[i] = np.nanmin(seg) if is_min else np.nanmax(seg)
+    out_sorted = np.where(m > 0, out_sorted, np.nan)
+    return unsort(out_sorted, np.float64)
 
 
 # ---------------------------------------------------------------------------

@@ -195,6 +195,7 @@ class _Parser:
         self.sql = sql
         self.toks = tokenize(sql)
         self.i = 0
+        self._gapfill = None  # GapfillSpec captured by select_statement
 
     # -- token helpers ---------------------------------------------------
     @property
@@ -284,12 +285,17 @@ class _Parser:
         distinct = self.accept_kw("distinct")
         select_list: List[Union[Expr, AggregationSpec]] = []
         aliases: List[Optional[str]] = []
+        self._gapfill = None
         while True:
             item, alias = self.select_item()
             select_list.append(item)
             aliases.append(alias)
             if not self.accept_op(","):
                 break
+        # capture before FROM/WHERE: a subquery's select_statement resets
+        # the parser-level slot
+        gapfill = self._gapfill
+        self._gapfill = None
         self.expect_kw("from")
         if self.cur.kind not in ("ident",):
             self.fail("expected table name")
@@ -457,15 +463,14 @@ class _Parser:
                 if isinstance(s, AggregationSpec):
                     return strip_agg(s)
                 if isinstance(s, WindowSpec):
-                    return WindowSpec(
-                        s.function,
-                        map_expr_columns(s.expr, strip_q) if s.expr is not None else None,
-                        tuple(map_expr_columns(p, strip_q) for p in s.partition_by),
-                        tuple(
+                    return dataclasses.replace(
+                        s,
+                        expr=map_expr_columns(s.expr, strip_q) if s.expr is not None else None,
+                        partition_by=tuple(map_expr_columns(p, strip_q) for p in s.partition_by),
+                        order_by=tuple(
                             OrderByExpr(map_expr_columns(o.expr, strip_q), o.ascending, o.nulls_last)
                             for o in s.order_by
                         ),
-                        s.frame,
                     )
                 return map_expr_columns(s, strip_q)
 
@@ -478,6 +483,13 @@ class _Parser:
                 for o in order_by
             ]
             extra_aggs = [strip_agg(s) for s in extra_aggs]
+            if gapfill is not None:
+                gapfill = dataclasses.replace(
+                    gapfill,
+                    time_expr=map_expr_columns(gapfill.time_expr, strip_q),
+                    fills=tuple((map_expr_columns(t, strip_q), m) for t, m in gapfill.fills),
+                    series=tuple(map_expr_columns(s, strip_q) for s in gapfill.series),
+                )
 
         return QueryContext(
             table=table,
@@ -493,6 +505,7 @@ class _Parser:
             offset=offset,
             options=options,
             extra_aggregations=extra_aggs,
+            gapfill=gapfill,
         )
 
     # -- FROM clause: aliases + joins -----------------------------------
@@ -547,13 +560,125 @@ class _Parser:
     # a misleading selection-expression error.
     _KNOWN_UNIMPLEMENTED_AGGS = frozenset({"distinctcountrawhll", "distinctcountthetasketch"})
 
-    _WINDOW_FNS = frozenset({"row_number", "rank", "dense_rank", "sum", "count", "avg", "min", "max"})
+    _WINDOW_FNS = frozenset({
+        "row_number", "rank", "dense_rank", "ntile",
+        "lag", "lead", "first_value", "last_value",
+        "sum", "count", "avg", "min", "max", "bool_and", "bool_or",
+    })
+
+    def _at_word(self, w: str) -> bool:
+        return self.cur.kind in ("ident", "kw") and str(self.cur.value).lower() == w
+
+    def _accept_word(self, w: str) -> bool:
+        if self._at_word(w):
+            self.advance()
+            return True
+        return False
+
+    def _expect_word(self, w: str) -> None:
+        if not self._accept_word(w):
+            self.fail(f"expected {w.upper()} in window frame")
+
+    def _frame_bound(self, is_lower: bool) -> Optional[float]:
+        """One frame bound as a signed offset: None = UNBOUNDED, 0 = CURRENT
+        ROW, -k = k PRECEDING, +k = k FOLLOWING (WindowFrame.java bounds)."""
+        if self._accept_word("unbounded"):
+            if is_lower:
+                self._expect_word("preceding")
+            else:
+                self._expect_word("following")
+            return None
+        if self._accept_word("current"):
+            self._expect_word("row")
+            return 0
+        if self.cur.kind != "number":
+            self.fail("expected UNBOUNDED, CURRENT ROW or <n> PRECEDING/FOLLOWING")
+        k = self.advance().value
+        if self._accept_word("preceding"):
+            return -k
+        self._expect_word("following")
+        return k
+
+    def _window_frame(self) -> Tuple[str, Optional[float], Optional[float]]:
+        """[ROWS|RANGE] [BETWEEN <bound> AND <bound> | <bound>]."""
+        if self._accept_word("rows"):
+            mode = "rows"
+        elif self._accept_word("range"):
+            mode = "range"
+        else:
+            return "range_all", None, None
+        if self._accept_word("between"):
+            lo = self._frame_bound(True)
+            self._expect_word("and")
+            hi = self._frame_bound(False)
+            if lo is not None and hi is not None and lo > hi:
+                self.fail("window frame start must not be after frame end")
+        else:
+            lo = self._frame_bound(True)
+            hi = 0  # shorthand: <bound> == BETWEEN <bound> AND CURRENT ROW
+            if lo is not None and lo > 0:
+                self.fail("shorthand window frame bound must be UNBOUNDED/k PRECEDING or CURRENT ROW")
+        if mode == "rows":
+            for b in (lo, hi):
+                if b is not None and float(b) != int(b):
+                    self.fail("ROWS frame bounds must be integers")
+            lo = None if lo is None else int(lo)
+            hi = None if hi is None else int(hi)
+        return mode, lo, hi
+
+    def _gapfill_item(self, e: Expr) -> Expr:
+        """Interpret a parsed GAPFILL(...) call: stash the GapfillSpec on the
+        parser (select_statement collects it) and return the time expression
+        as the select item (the bucket output column)."""
+        from pinot_tpu.query.ir import GapfillSpec
+
+        if len(e.args) < 4:
+            self.fail("GAPFILL requires (time_expr, start, end, step, ...)")
+        time_expr = e.args[0]
+
+        def _int_lit(a: Expr, what: str) -> int:
+            if not a.is_literal:
+                self.fail(f"GAPFILL {what} must be a literal")
+            try:
+                return int(a.value)
+            except (TypeError, ValueError):
+                self.fail(f"GAPFILL {what} must be an integer (got {a.value!r})")
+
+        start = _int_lit(e.args[1], "start")
+        end = _int_lit(e.args[2], "end")
+        step = _int_lit(e.args[3], "step")
+        if step <= 0:
+            self.fail("GAPFILL step must be positive")
+        fills: List[tuple] = []
+        series: List[Expr] = []
+        for a in e.args[4:]:
+            if not (isinstance(a, Expr) and a.kind.name == "CALL"):
+                self.fail(f"unexpected GAPFILL argument {a}")
+            if a.op == "fill":
+                if len(a.args) != 2 or not a.args[1].is_literal:
+                    self.fail("FILL requires (target, 'mode')")
+                mode = str(a.args[1].value).upper()
+                if mode not in ("FILL_PREVIOUS_VALUE", "FILL_DEFAULT_VALUE"):
+                    self.fail(f"unknown FILL mode {mode!r}")
+                fills.append((a.args[0], mode))
+            elif a.op == "timeserieson":
+                series.extend(a.args)
+            else:
+                self.fail(f"unexpected GAPFILL argument {a.op!r}")
+        if self._gapfill is not None:
+            self.fail("only one GAPFILL per query")
+        self._gapfill = GapfillSpec(
+            time_expr, start, end, step, tuple(fills), tuple(series)
+        )
+        return time_expr
 
     def expr_or_agg(self) -> Union[Expr, AggregationSpec]:
         """Expression that may be a top-level aggregation call."""
         e = self.expr()
         if isinstance(e, Expr) and e.kind.name == "CALL" and e.op in self._KNOWN_UNIMPLEMENTED_AGGS:
             self.fail(f"aggregation function {e.op!r} is not supported yet")
+        if isinstance(e, Expr) and e.kind.name == "CALL" and e.op == "gapfill":
+            return self._gapfill_item(e)
         # window function: fn(...) OVER (PARTITION BY ... ORDER BY ...)
         if isinstance(e, Expr) and e.kind.name == "CALL" and self.at_kw("over"):
             if e.op not in self._WINDOW_FNS:
@@ -580,23 +705,36 @@ class _Parser:
                     worder.append(OrderByExpr(oe, ascending=asc))
                     if not self.accept_op(","):
                         break
-            frame = "range_all"
-            if self.cur.kind == "ident" and str(self.cur.value).lower() == "rows":
-                # ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW
-                self.advance()
-                for w in ("between", "unbounded", "preceding", "and", "current", "row"):
-                    t = self.cur
-                    if t.kind not in ("ident", "kw") or str(t.value).lower() != w:
-                        self.fail(
-                            "only ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW frames are supported"
-                        )
-                    self.advance()
-                frame = "rows_cumulative"
+            frame, frame_lo, frame_hi = self._window_frame()
             self.expect_op(")")
             arg = None
-            if e.args and not (e.args[0].is_column and e.args[0].op == "*"):
+            literal_args: Tuple = ()
+            if e.op == "ntile":
+                # NTILE(n): the single argument is the bucket count literal
+                if len(e.args) != 1 or not e.args[0].is_literal:
+                    self.fail("NTILE requires one literal bucket count")
+                if int(e.args[0].value) < 1:
+                    self.fail("NTILE bucket count must be >= 1")
+                literal_args = (int(e.args[0].value),)
+            elif e.op in ("lag", "lead"):
+                # LAG/LEAD(expr [, offset [, default]])
+                if not e.args:
+                    self.fail(f"{e.op.upper()} requires an argument")
                 arg = e.args[0]
-            return WindowSpec(e.op, arg, tuple(partition), tuple(worder), frame)
+                extras = []
+                for a in e.args[1:]:
+                    if not a.is_literal:
+                        self.fail(f"{e.op.upper()} offset/default must be literals")
+                    extras.append(a.value)
+                if extras:
+                    extras[0] = int(extras[0])
+                literal_args = tuple(extras)
+            elif e.args and not (e.args[0].is_column and e.args[0].op == "*"):
+                arg = e.args[0]
+            return WindowSpec(
+                e.op, arg, tuple(partition), tuple(worder),
+                frame, frame_lo, frame_hi, literal_args,
+            )
         if isinstance(e, Expr) and e.kind.name == "CALL" and is_agg_function(e.op):
             spec = self._call_to_agg(e)
             # FILTER (WHERE ...) clause — Pinot filtered aggregations

@@ -1,0 +1,152 @@
+"""GAPFILL: post-reduce time-bucket gap filling.
+
+Round-4 verdict missing #2.  Reference: pinot-core/.../core/query/reduce/
+GapfillProcessor.java + SumAvgGapfillProcessor.java (FILL modes per
+GapfillUtils).  sqlite has no gapfill, so goldens are hand-computed over a
+deliberately sparse series.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.sql.parser import SqlParseError, parse_query
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+
+def _schema():
+    return Schema(
+        "ts",
+        [
+            FieldSpec("bucket", DataType.LONG),
+            FieldSpec("device", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+
+
+@pytest.fixture(scope="module")
+def eng():
+    # sparse series: device a has buckets 100,120,130; device b has 110,130
+    data = {
+        "bucket": np.array([100, 100, 120, 130, 110, 130, 90, 200], np.int64),
+        "device": np.array(["a", "a", "a", "a", "b", "b", "a", "b"], object),
+        "v": np.array([1, 2, 5, 7, 4, 6, 99, 99], np.int64),
+    }
+    e = QueryEngine()
+    e.register_table(_schema())
+    e.add_segment("ts", build_segment(_schema(), data, "s0"))
+    return e
+
+
+def test_gapfill_default_null_fill(eng):
+    res = eng.query(
+        "SELECT GAPFILL(bucket, 100, 140, 10), SUM(v) FROM ts "
+        "WHERE device = 'a' GROUP BY bucket LIMIT 100"
+    )
+    assert res.rows == [
+        (100, 3),   # 1 + 2
+        (110, None),
+        (120, 5),
+        (130, 7),
+    ]
+
+
+def test_gapfill_previous_value(eng):
+    res = eng.query(
+        "SELECT GAPFILL(bucket, 100, 140, 10, FILL(SUM(v), 'FILL_PREVIOUS_VALUE')), "
+        "SUM(v) FROM ts WHERE device = 'a' GROUP BY bucket LIMIT 100"
+    )
+    assert res.rows == [
+        (100, 3),
+        (110, 3),  # carried from bucket 100
+        (120, 5),
+        (130, 7),
+    ]
+
+
+def test_gapfill_timeserieson(eng):
+    res = eng.query(
+        "SELECT GAPFILL(bucket, 100, 140, 10, FILL(SUM(v), 'FILL_PREVIOUS_VALUE'), "
+        "TIMESERIESON(device)), device, SUM(v) FROM ts "
+        "GROUP BY bucket, device ORDER BY device, bucket LIMIT 100"
+    )
+    assert res.rows == [
+        (100, "a", 3),
+        (110, "a", 3),
+        (120, "a", 5),
+        (130, "a", 7),
+        (100, "b", None),  # no previous value yet
+        (110, "b", 4),
+        (120, "b", 4),     # carried
+        (130, "b", 6),
+    ]
+
+
+def test_gapfill_out_of_range_rows_dropped(eng):
+    # bucket 90 (v=99) and 200 (v=99) lie outside [100, 140): never emitted,
+    # and 90's value must not leak in via FILL_PREVIOUS_VALUE
+    res = eng.query(
+        "SELECT GAPFILL(bucket, 100, 140, 10, FILL(SUM(v), 'FILL_PREVIOUS_VALUE')), "
+        "SUM(v) FROM ts WHERE device = 'a' GROUP BY bucket LIMIT 100"
+    )
+    buckets = [r[0] for r in res.rows]
+    assert buckets == [100, 110, 120, 130]
+    assert all(r[1] != 99 for r in res.rows)
+
+
+def test_gapfill_alias_fill_target(eng):
+    res = eng.query(
+        "SELECT GAPFILL(bucket, 100, 140, 10, FILL(s, 'FILL_PREVIOUS_VALUE')), "
+        "SUM(v) AS s, COUNT(*) FROM ts WHERE device = 'a' GROUP BY bucket LIMIT 100"
+    )
+    # SUM carries forward; COUNT (no FILL spec) defaults to NULL on gaps
+    assert res.rows == [
+        (100, 3, 2),
+        (110, 3, None),
+        (120, 5, 1),
+        (130, 7, 1),
+    ]
+
+
+def test_gapfill_default_value_fill(eng):
+    """FILL_DEFAULT_VALUE fills the column type's default (0 for numeric),
+    not NULL (review-caught)."""
+    res = eng.query(
+        "SELECT GAPFILL(bucket, 100, 140, 10, FILL(SUM(v), 'FILL_DEFAULT_VALUE')), "
+        "SUM(v) FROM ts WHERE device = 'a' GROUP BY bucket LIMIT 100"
+    )
+    assert res.rows == [
+        (100, 3),
+        (110, 0),
+        (120, 5),
+        (130, 7),
+    ]
+
+
+def test_gapfill_order_by_desc(eng):
+    res = eng.query(
+        "SELECT GAPFILL(bucket, 100, 140, 10), SUM(v) FROM ts "
+        "WHERE device = 'a' GROUP BY bucket ORDER BY bucket DESC LIMIT 2"
+    )
+    assert res.rows == [(130, 7), (120, 5)]
+
+
+def test_gapfill_parse_errors():
+    with pytest.raises(SqlParseError, match="step must be positive"):
+        parse_query("SELECT GAPFILL(b, 0, 10, 0), SUM(v) FROM t GROUP BY b")
+    with pytest.raises(SqlParseError, match="FILL mode"):
+        parse_query(
+            "SELECT GAPFILL(b, 0, 10, 1, FILL(SUM(v), 'FILL_SIDEWAYS')), SUM(v) "
+            "FROM t GROUP BY b"
+        )
+    with pytest.raises(SqlParseError, match="GAPFILL requires"):
+        parse_query("SELECT GAPFILL(b, 0, 10), SUM(v) FROM t GROUP BY b")
+
+
+def test_gapfill_unselected_fill_target_errors(eng):
+    with pytest.raises(Exception, match="not in the select list"):
+        eng.query(
+            "SELECT GAPFILL(bucket, 100, 140, 10, FILL(MAX(v), 'FILL_PREVIOUS_VALUE')), "
+            "SUM(v) FROM ts GROUP BY bucket LIMIT 10"
+        )

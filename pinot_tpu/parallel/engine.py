@@ -119,19 +119,30 @@ class _ShardView:
     """Compile-time segment facade over a StackedTable: FilterCompiler and
     transform tracing only consult metadata (dictionaries, nulls, dtypes) and
     num_docs for match-all shapes — here num_docs is the per-device flat row
-    count (local shards x docs_per_shard).
+    count for ONE launch (local shards x batch docs).
 
     When axis/ndev are given, FilterCompiler compiles SHARD-AWARE index
-    paths: bitmap params split on the device axis, doc ranges compare
-    against global flat doc ids (query/filter.py shard_info)."""
+    paths: bitmap params stored full as [ndev, L, D//32] and sliced per
+    macro-batch by the engine, doc ranges compare against global flat doc
+    ids via `docs_fn` (query/filter.py)."""
 
-    def __init__(self, stacked, local_rows: int, axis: Optional[str] = None, ndev: int = 0):
+    def __init__(
+        self,
+        stacked,
+        local_rows: int,
+        axis: Optional[str] = None,
+        ndev: int = 0,
+        docs_fn: Optional[Callable] = None,
+        bitmap_layout: Optional[Tuple[int, int, int]] = None,
+    ):
         self._stacked = stacked
         self.num_docs = local_rows
         self.schema = stacked.schema
         self.total_docs = stacked.num_docs
         self.indexes = getattr(stacked, "indexes", {})
         self.shard_info = (axis, ndev, local_rows) if axis is not None else None
+        self.docs_fn = docs_fn
+        self.bitmap_layout = bitmap_layout
 
     def column(self, name: str):
         return self._stacked.column(name)
@@ -140,7 +151,7 @@ class _ShardView:
 @dataclass
 class _DistPlan:
     kind: str  # aggregation | groupby_dense | groupby_sparse | selection
-    fn: Callable  # jitted shard_map kernel(cols, valid, params)
+    fn: Callable  # jitted shard_map kernel(cols, params)
     params: Dict[str, Any]
     needed_columns: List[str]
     aggs: List[Any]
@@ -151,12 +162,19 @@ class _DistPlan:
     row_sharded_params: frozenset = frozenset()
     # (column, index kind) per index-accelerated filter predicate
     index_uses: Tuple = ()
+    # macro-batch launch schedule: each launch covers doc columns
+    # [off, off+batch_docs) of the [S, D] arrays; `fresh` marks the first
+    # not-yet-covered within-batch column (tail overlap masking)
+    batch_docs: int = 0
+    batch_offsets: Tuple[Tuple[int, int], ...] = ((0, 0),)
 
 
 class DistributedEngine:
     """Executes queries over a StackedTable sharded on a device mesh."""
 
-    def __init__(self, mesh=None, axis: str = "seg"):
+    def __init__(self, mesh=None, axis: str = "seg", launch_bytes: Optional[int] = None):
+        import os
+
         if mesh is None:
             from pinot_tpu.parallel.mesh import default_mesh
 
@@ -165,6 +183,13 @@ class DistributedEngine:
         self.axis = axis
         self.tables: Dict[str, Any] = {}  # name -> StackedTable
         self._plan_cache: Dict[Tuple, _DistPlan] = {}
+        # per-device bytes one launch may capture (macro-batching threshold);
+        # ~2GB leaves the while-loop capture copy well under HBM headroom
+        self.launch_bytes = (
+            launch_bytes
+            if launch_bytes is not None
+            else int(os.environ.get("PINOT_TPU_LAUNCH_BYTES", str(2 << 30)))
+        )
 
     @property
     def num_devices(self) -> int:
@@ -208,8 +233,7 @@ class DistributedEngine:
         )
         plan = self._plan(ctx, stacked)
         stats.add_index_uses(plan.index_uses)
-        cols, valid = stacked.to_device(self.mesh, self.axis, plan.needed_columns)
-        result = self._run(ctx, plan, stacked, cols, valid, stats)
+        result = self._run(ctx, plan, stacked, stats)
         out = reduce_mod.reduce_results(ctx, [result], stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         return out
@@ -230,25 +254,112 @@ class DistributedEngine:
             ctx.options.setdefault(
                 f"__dictfp__{col}", c.dictionary.fingerprint() if c.has_dictionary else ""
             )
+            if c.has_dictionary:
+                ctx.options.setdefault(f"__dictvals__{col}", c.dictionary.values)
             if c.stats.min_value is not None and not c.data_type.is_string_like:
                 ctx.options.setdefault(f"__range__{col}", (c.stats.min_value, c.stats.max_value))
 
     # ------------------------------------------------------------------
     def _plan(self, ctx: QueryContext, stacked) -> _DistPlan:
-        key = (ctx.fingerprint(), stacked.signature(), self.axis, self.num_devices)
+        batch_docs, batch_offsets = self._batching(ctx, stacked)
+        key = (
+            ctx.fingerprint(), stacked.signature(), self.axis, self.num_devices, batch_docs,
+        )
         cached = self._plan_cache.get(key)
         if cached is not None:
             return cached
-        plan = self._build_plan(ctx, stacked)
+        plan = self._build_plan(ctx, stacked, batch_docs, batch_offsets)
         self._plan_cache[key] = plan
         return plan
 
-    def _build_plan(self, ctx: QueryContext, stacked) -> _DistPlan:
+    def _batching(self, ctx: QueryContext, stacked) -> Tuple[int, Tuple[Tuple[int, int], ...]]:
+        """Macro-batch launch schedule (round 5, VERDICT r4 #2).
+
+        XLA materializes one copy of every while-loop-captured buffer, so a
+        single launch's resident HBM is ~2x its input bytes — at 1B rows
+        that alone exceeds a v5e chip.  Splitting the doc axis into B
+        host-level launches caps the copy at one batch's bytes; the combine
+        across launches is group-table-sized (never row-length).  Batch
+        width is 32-aligned so index bitmap words slice cleanly; a ragged
+        tail re-launches the last full-width window with already-covered
+        rows masked via the `fresh` offset (same trick as
+        ops/segmented._fused_scan_inchunk)."""
+        D = stacked.docs_per_shard
+        L = stacked.num_shards // self.num_devices
+        # Per-doc bytes over the WHOLE table, not the query's needed columns:
+        # batch width must be a pure function of the table so every query
+        # shares one doc slicing — per-query widths would cache duplicate
+        # on-device slices of the same column (review-caught: at 1B rows the
+        # second slicing is the OOM the batching exists to prevent).  Narrow
+        # queries over-batch slightly; launch overhead is microseconds.
+        bytes_per_doc = 0
+        for c in stacked.columns.values():
+            if c.codes is not None:
+                width = c.codes.shape[2] if c.codes.ndim == 3 else 1
+                bytes_per_doc += c.codes.dtype.itemsize * width
+            if c.values is not None:
+                bytes_per_doc += c.values.dtype.itemsize
+            if c.nulls is not None:
+                bytes_per_doc += 1
+            if c.mv_lengths is not None:
+                bytes_per_doc += c.mv_lengths.dtype.itemsize
+        per_dev = max(1, bytes_per_doc) * L * D
+        n_batches = max(1, -(-per_dev // self.launch_bytes))
+        if n_batches == 1 or D < 64:
+            return D, ((0, 0),)
+        batch_docs = min(D, -(-(-(-D // n_batches)) // 32) * 32)
+        offsets = []
+        off = 0
+        while off + batch_docs <= D:
+            offsets.append((off, 0))
+            off += batch_docs
+        if off < D:
+            tail = D - batch_docs
+            offsets.append((tail, off - tail))
+        return batch_docs, tuple(offsets)
+
+    def _build_plan(
+        self,
+        ctx: QueryContext,
+        stacked,
+        batch_docs: int,
+        batch_offsets: Tuple[Tuple[int, int], ...],
+    ) -> _DistPlan:
         axis = self.axis
         ndev = self.num_devices
         local_shards = stacked.num_shards // ndev
-        local_rows = local_shards * stacked.docs_per_shard
-        view = _ShardView(stacked, local_rows, axis=axis, ndev=ndev)
+        D_full = stacked.docs_per_shard
+        local_rows = local_shards * batch_docs
+        L = local_shards
+        Db = batch_docs
+        has_padding = stacked.num_docs < stacked.num_shards * D_full
+        use_fresh = any(fresh for _, fresh in batch_offsets)
+
+        def docs_fn(params):
+            """Global flat doc ids for this device's rows in this launch."""
+            base = lax.axis_index(axis).astype(jnp.int32) * np.int32(L * D_full)
+            off = params["__boff__"].astype(jnp.int32)
+            return (
+                base
+                + off
+                + jnp.arange(L, dtype=jnp.int32)[:, None] * np.int32(D_full)
+                + jnp.arange(Db, dtype=jnp.int32)[None, :]
+            ).reshape(-1)
+
+        def _valid_mask(params):
+            m = None
+            if has_padding:
+                m = docs_fn(params) < np.int32(stacked.num_docs)
+            if use_fresh:
+                f = jnp.tile(jnp.arange(Db, dtype=jnp.int32) >= params["__fresh__"], L)
+                m = f if m is None else m & f
+            return m
+
+        assert D_full % 32 == 0, "docs_per_shard must be 32-aligned (StackedTable.build)"
+        view = _ShardView(
+            stacked, local_rows, axis=axis, ndev=ndev,
+            docs_fn=docs_fn, bitmap_layout=(ndev, L, D_full // 32),
+        )
 
         fc = FilterCompiler(view, ctx.null_handling)
         filter_fn = fc.compile(ctx.filter)
@@ -298,10 +409,12 @@ class DistributedEngine:
 
         if kind == "aggregation":
 
-            def shard_kernel(cols, valid, params):
+            def shard_kernel(cols, params):
                 cols = _flat(cols)
                 tmask, _ = filter_fn(cols, params)
-                tmask = tmask & valid.reshape(-1)
+                vm = _valid_mask(params)
+                if vm is not None:
+                    tmask = tmask & vm
                 partials = [fn.partial(v, m) for fn, (v, m) in zip(aggs, _agg_inputs(cols, params, tmask))]
                 return [
                     {f: _psum_field(f, x, axis) for f, x in p.items()} for p in partials
@@ -312,10 +425,12 @@ class DistributedEngine:
         elif kind == "groupby_dense":
             vranges = planner_mod.agg_vranges(agg_specs, stacked)
 
-            def shard_kernel(cols, valid, params):
+            def shard_kernel(cols, params):
                 cols = _flat(cols)
                 tmask, _ = filter_fn(cols, params)
-                tmask = tmask & valid.reshape(-1)
+                vm = _valid_mask(params)
+                if vm is not None:
+                    tmask = tmask & vm
                 key = _group_key(cols)
                 inputs = _agg_inputs(cols, params, tmask)
                 presence, partials = planner_mod.grouped_partials(
@@ -343,10 +458,12 @@ class DistributedEngine:
             # the reference's server-side numGroupsLimit trim)
             order_spec = planner_mod.kernel_order_spec(ctx, aggs)
 
-            def shard_kernel(cols, valid, params):
+            def shard_kernel(cols, params):
                 cols = _flat(cols)
                 tmask, _ = filter_fn(cols, params)
-                tmask = tmask & valid.reshape(-1)
+                vm = _valid_mask(params)
+                if vm is not None:
+                    tmask = tmask & vm
                 key = planner_mod.packed_key64(cols, group_dims, view)
                 inputs = _agg_inputs(cols, params, tmask)
                 return planner_mod.sparse_grouped_tables(
@@ -357,10 +474,13 @@ class DistributedEngine:
 
         else:  # selection
 
-            def shard_kernel(cols, valid, params):
+            def shard_kernel(cols, params):
                 cols = _flat(cols)
                 tmask, _ = filter_fn(cols, params)
-                return tmask & valid.reshape(-1)
+                vm = _valid_mask(params)
+                if vm is not None:
+                    tmask = tmask & vm
+                return tmask
 
             out_specs = P(self.axis)
 
@@ -391,21 +511,24 @@ class DistributedEngine:
                     raise NotImplementedError(f"selection expression {s} not yet supported")
 
         mesh = self.mesh
+        # launch-schedule params: batch doc offset + fresh floor (tail
+        # overlap masking); always present so every batch shares one pytree
+        fc.params["__boff__"] = np.int32(0)
+        fc.params["__fresh__"] = np.int32(0)
         row_sharded = frozenset(fc.row_sharded_params)
 
-        def run(cols, valid, params):
+        def run(cols, params):
             kern = jax.shard_map(
                 shard_kernel,
                 mesh=mesh,
                 in_specs=(
                     _col_specs(cols),
-                    P(axis, None),
                     {k: (P(axis, None) if k in row_sharded else P()) for k in params},
                 ),
                 out_specs=out_specs,
                 check_vma=False,
             )
-            return kern(cols, valid, params)
+            return kern(cols, params)
 
         fn = jax.jit(run)
 
@@ -427,27 +550,77 @@ class DistributedEngine:
             select_columns=select_columns,
             row_sharded_params=frozenset(fc.row_sharded_params),
             index_uses=tuple(fc.index_uses),
+            batch_docs=batch_docs,
+            batch_offsets=tuple(batch_offsets),
         )
 
     # ------------------------------------------------------------------
-    def _run(self, ctx, plan: _DistPlan, stacked, cols, valid, stats: ExecutionStats):
-        params = {
-            k: jax.device_put(
-                v,
-                NamedSharding(
-                    self.mesh, P(self.axis, None) if k in plan.row_sharded_params else P()
-                ),
+    def batch_params(self, plan: _DistPlan, off: int, fresh: int) -> Dict[str, Any]:
+        """Host-side params for the launch covering docs [off, off+batch_docs):
+        schedule scalars set, row-sharded bitmap words sliced on the doc axis."""
+        p = dict(plan.params)
+        p["__boff__"] = np.int32(off)
+        p["__fresh__"] = np.int32(fresh)
+        wlo, whi = off // 32, (off + plan.batch_docs) // 32
+        for k in plan.row_sharded_params:
+            w = plan.params[k]  # [ndev, L, D//32]
+            p[k] = np.ascontiguousarray(w[:, :, wlo:whi]).reshape(w.shape[0], -1)
+        return p
+
+    def device_batches(self, plan: _DistPlan, stacked) -> List[Tuple[Dict, Dict]]:
+        """Device-placed (cols, params) per macro-batch launch (bench.py's
+        marginal-timing hook shares this with _run)."""
+        out = []
+        for off, fresh in plan.batch_offsets:
+            cols, _ = stacked.to_device(
+                self.mesh, self.axis, plan.needed_columns,
+                doc_slice=(off, off + plan.batch_docs), with_valid=False,
             )
-            for k, v in plan.params.items()
-        }
+            params = {
+                k: jax.device_put(
+                    v,
+                    NamedSharding(
+                        self.mesh, P(self.axis, None) if k in plan.row_sharded_params else P()
+                    ),
+                )
+                for k, v in self.batch_params(plan, off, fresh).items()
+            }
+            out.append((cols, params))
+        return out
+
+    @staticmethod
+    def _combine_partials(parts_list):
+        """Fold per-batch partials (list over batches of list-of-field-dicts)
+        with the same add/min/max semantics as the in-graph psum combine
+        (functions.combine_field — the one FIELD_COMBINE dispatch)."""
+        from pinot_tpu.query.functions import combine_field
+
+        out = parts_list[0]
+        for nxt in parts_list[1:]:
+            out = [
+                {f: combine_field(f, p[f], q[f]) for f in p}
+                for p, q in zip(out, nxt)
+            ]
+        return out
+
+    def _run(self, ctx, plan: _DistPlan, stacked, stats: ExecutionStats):
+        # Launches are SERIALIZED (device_get per batch): each in-flight
+        # execution holds a capture copy of its batch inputs; overlapping B
+        # launches would re-create the whole-table copy the batching exists
+        # to avoid.  With one batch this is the plain async dispatch.
+        batch_outs = []
+        for cols, params in self.device_batches(plan, stacked):
+            batch_outs.append(jax.device_get(plan.fn(cols, params)))
 
         if plan.kind == "aggregation":
-            partials = jax.device_get(plan.fn(cols, valid, params))
+            partials = self._combine_partials(batch_outs)
             return AggSegmentResult(partials=partials)
 
         if plan.kind == "groupby_dense":
-            presence, partials = jax.device_get(plan.fn(cols, valid, params))
-            presence = np.asarray(presence)
+            presence = np.asarray(batch_outs[0][0])
+            for p, _ in batch_outs[1:]:
+                presence = presence + np.asarray(p)
+            partials = self._combine_partials([p for _, p in batch_outs])
             dense = DenseGroupData(
                 presence=presence,
                 partials=partials,
@@ -468,7 +641,16 @@ class DistributedEngine:
             return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense)
 
         if plan.kind == "groupby_sparse":
-            uniq, partials = jax.device_get(plan.fn(cols, valid, params))
+            # batches concatenate like extra devices: sparse_tables_to_result
+            # merges duplicate keys across the [B*ndev*K] stacked tables
+            uniq = np.concatenate([np.asarray(u).reshape(-1) for u, _ in batch_outs])
+            partials = [
+                {
+                    f: np.concatenate([np.asarray(p[i][f]) for _, p in batch_outs])
+                    for f in batch_outs[0][1][i]
+                }
+                for i in range(len(batch_outs[0][1]))
+            ]
             res = sse_executor.sparse_tables_to_result(
                 plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit,
                 order_trim=planner_mod.order_by_agg_index(ctx),
@@ -476,8 +658,16 @@ class DistributedEngine:
             stats.num_groups = len(res.keys[0]) if res.keys else 0
             return res
 
-        # selection
-        tmask = np.asarray(jax.device_get(plan.fn(cols, valid, params)))
+        # selection: reassemble the [S, D] mask from the per-batch doc slices
+        # (only the fresh part of a ragged tail writes back)
+        S, D = stacked.num_shards, stacked.docs_per_shard
+        if plan.batch_offsets == ((0, 0),) and plan.batch_docs == D:
+            tmask = np.asarray(batch_outs[0])
+        else:
+            tmask = np.zeros((S, D), dtype=bool)
+            for (off, fresh), out in zip(plan.batch_offsets, batch_outs):
+                m = np.asarray(out).reshape(S, plan.batch_docs)
+                tmask[:, off + fresh : off + plan.batch_docs] = m[:, fresh:]
         return self._gather_selection(ctx, plan, stacked, tmask)
 
     def _gather_selection(self, ctx, plan: _DistPlan, stacked, tmask: np.ndarray) -> SelectionSegmentResult:

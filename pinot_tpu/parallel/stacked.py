@@ -315,11 +315,24 @@ class StackedTable:
         )
 
     # -- device residency ----------------------------------------------
-    def to_device(self, mesh=None, axis: str = "seg", columns: Optional[List[str]] = None):
+    def to_device(
+        self,
+        mesh=None,
+        axis: str = "seg",
+        columns: Optional[List[str]] = None,
+        doc_slice: Optional[Tuple[int, int]] = None,
+        with_valid: bool = True,
+    ):
         """Shard row arrays over the mesh axis; dictionaries replicate.
 
         Returns (cols_pytree, valid) of jax arrays with NamedSharding — the
-        input side of the shard_map combine kernel (parallel/engine.py)."""
+        input side of the shard_map combine kernel (parallel/engine.py).
+
+        doc_slice=(lo, hi) ships only columns [:, lo:hi] of the [S, D] row
+        arrays — the macro-batch launch path (parallel/engine.py batching):
+        at 1B rows a single launch's while-loop capture copy alone exceeds
+        HBM, so the engine slices the doc axis into batches and combines
+        the table-sized partials across launches."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -331,29 +344,48 @@ class StackedTable:
         rep_sharding = NamedSharding(mesh, P())
         cache = self._device_cache.setdefault(id(mesh), {})
         cols = columns or list(self.columns)
+        sl = doc_slice if doc_slice is not None else (0, self.docs_per_shard)
+
+        def _rows(a: np.ndarray) -> np.ndarray:
+            if sl == (0, self.docs_per_shard):
+                return a
+            return np.ascontiguousarray(a[:, sl[0] : sl[1]])
+
         out: Dict[str, Dict[str, Any]] = {}
         for cname in cols:
-            if cname in cache:
-                out[cname] = cache[cname]
+            ck = (cname, sl)
+            if ck in cache:
+                out[cname] = cache[ck]
                 continue
             c = self.columns[cname]
             entry: Dict[str, Any] = {}
             if c.codes is not None:
-                entry["codes"] = jax.device_put(c.codes, row_sharding)
+                entry["codes"] = jax.device_put(_rows(c.codes), row_sharding)
+                dkey = (cname, "dict")
                 dvals = c.dictionary.device_values()
                 if dvals is not None:
-                    entry["dict"] = jax.device_put(dvals, rep_sharding)
+                    if dkey not in cache:
+                        cache[dkey] = jax.device_put(dvals, rep_sharding)
+                    entry["dict"] = cache[dkey]
             if c.values is not None:
-                entry["values"] = jax.device_put(c.values, row_sharding)
+                entry["values"] = jax.device_put(_rows(c.values), row_sharding)
             if c.nulls is not None:
-                entry["nulls"] = jax.device_put(c.nulls, row_sharding)
+                entry["nulls"] = jax.device_put(_rows(c.nulls), row_sharding)
             if c.mv_lengths is not None:
-                entry["lengths"] = jax.device_put(c.mv_lengths, row_sharding)
-            cache[cname] = entry
+                entry["lengths"] = jax.device_put(_rows(c.mv_lengths), row_sharding)
+            cache[ck] = entry
             out[cname] = entry
-        if "__valid__" not in cache:
-            cache["__valid__"] = jax.device_put(self.valid, row_sharding)
-        return out, cache["__valid__"]
+        if not with_valid:
+            # distributed-engine path: validity is computed IN-KERNEL from
+            # static num_docs (padding is always trailing in the global flat
+            # doc space by construction) — at 1B rows the [S, D] bool buffer
+            # plus its while-loop capture copy is ~2GB of HBM for a mask the
+            # kernel can derive from an iota compare.
+            return out, None
+        vk = ("__valid__", sl)
+        if vk not in cache:
+            cache[vk] = jax.device_put(_rows(self.valid), row_sharding)
+        return out, cache[vk]
 
     def release_device(self) -> None:
         self._device_cache = {}

@@ -123,6 +123,12 @@ class FilterCompiler:
         # local_rows) — bitmap params split on the leading device axis and
         # doc ranges compare against GLOBAL flat doc ids (parallel/engine.py)
         self.shard_info: Optional[Tuple[str, int, int]] = getattr(segment, "shard_info", None)
+        # Macro-batch launches (parallel/engine.py): per-device global doc
+        # ids come from a params-dependent closure (the batch offset is a
+        # param), and bitmap words are stored FULL as [ndev, L, D//32] so
+        # the engine can slice the doc axis per launch.
+        self.docs_fn = getattr(segment, "docs_fn", None)
+        self.bitmap_layout: Optional[Tuple[int, int, int]] = getattr(segment, "bitmap_layout", None)
         # param keys whose leading axis is the device axis (in_spec P(axis))
         self.row_sharded_params: set = set()
 
@@ -431,9 +437,12 @@ class FilterCompiler:
         self._null_guard(name, has_nulls)
         self.index_uses.append((name, "sorted"))
         shard_info = self.shard_info
+        docs_fn = self.docs_fn
 
         def eval_docrange(cols, params, _lo=lo_key, _hi=hi_key, _name=name, _has=has_nulls):
-            if shard_info is not None:
+            if docs_fn is not None:
+                docs = docs_fn(params)
+            elif shard_info is not None:
                 axis, _, local_rows = shard_info
                 from jax import lax
 
@@ -453,7 +462,14 @@ class FilterCompiler:
         n = self.segment.num_docs
         key = self._key("bits")
         words = np.ascontiguousarray(words, dtype=np.uint32)
-        if self.shard_info is not None:
+        if self.bitmap_layout is not None:
+            # macro-batch engine: store FULL words as [ndev, L, D//32]; the
+            # engine slices the doc axis per launch to [ndev, L*Db//32]
+            # (parallel/engine.py _batch_params)
+            assert words.size == int(np.prod(self.bitmap_layout)), (words.size, self.bitmap_layout)
+            words = words.reshape(self.bitmap_layout)
+            self.row_sharded_params.add(key)
+        elif self.shard_info is not None:
             # split words on the device axis: each device ships + unpacks
             # ONLY its slice (local_rows is 32-aligned by construction)
             _, ndev, local_rows = self.shard_info

@@ -187,8 +187,12 @@ def test_distributed_bitmap_params_shard_sliced(dist_env):
     bits = [plan.params[k] for k in plan.row_sharded_params]
     assert len(bits) == 1
     ndev = dist_env.num_devices
-    local_rows = (stacked.num_shards // ndev) * stacked.docs_per_shard
-    assert bits[0].shape == (ndev, local_rows // 32)
+    L = stacked.num_shards // ndev
+    # stored full as [ndev, L, D//32]; launch params slice the doc axis
+    assert bits[0].shape == (ndev, L, stacked.docs_per_shard // 32)
+    key = next(iter(plan.row_sharded_params))
+    launch = dist_env.batch_params(plan, 0, 0)
+    assert launch[key].shape == (ndev, L * plan.batch_docs // 32)
 
 
 def test_distributed_sorted_doc_range(dist_env):

@@ -1,0 +1,148 @@
+"""Macro-batch launch path (round 5, VERDICT r4 #2).
+
+At 1B rows a single shard_map launch OOMs a v5e chip because XLA keeps one
+copy of every while-loop-captured column buffer; the engine splits the doc
+axis into host-level launches and combines table-sized partials
+(parallel/engine.py _batching / device_batches).  These tests force tiny
+launch budgets on the 8-device CPU mesh so every query kind crosses batch
+boundaries — including a ragged tail (overlap + fresh masking), trailing
+padding, sorted doc-range filters (global doc ids via __boff__), and
+bitmap-index word slicing.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.parallel.engine import DistributedEngine
+from pinot_tpu.parallel.stacked import StackedTable
+from pinot_tpu.spi.config import IndexingConfig, TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+from pinot_tpu.sql.parser import parse_query
+
+N = 1245  # with 8 shards -> D = 160 (32-aligned), 35 trailing padding rows
+
+
+def _schema(name: str) -> Schema:
+    return Schema(
+        name,
+        [
+            FieldSpec("day", DataType.INT),
+            FieldSpec("g", DataType.STRING),
+            FieldSpec("v", DataType.INT, role=FieldRole.METRIC),
+        ],
+    )
+
+
+def _data(rng):
+    return {
+        "day": rng.integers(0, 200, N).astype(np.int32),
+        "g": np.asarray([f"g{i}" for i in rng.integers(0, 7, N)]),
+        "v": rng.integers(-1000, 1000, N).astype(np.int32),
+    }
+
+
+@pytest.fixture(scope="module")
+def engines():
+    rng = np.random.default_rng(11)
+    data = _data(rng)
+    cfg = TableConfig(
+        "t",
+        indexing=IndexingConfig(sorted_column="day", inverted_index_columns=["g"]),
+    )
+
+    def build(budget):
+        eng = DistributedEngine(launch_bytes=budget)
+        eng.register_table(
+            "t", StackedTable.build(_schema("t"), dict(data), eng.num_devices, table_config=cfg)
+        )
+        return eng
+
+    return build
+
+
+QUERIES = [
+    "SELECT COUNT(*), SUM(v), MIN(v), MAX(v) FROM t",
+    "SELECT COUNT(*), SUM(v) FROM t WHERE day < 50",
+    "SELECT g, COUNT(*), SUM(v) FROM t GROUP BY g ORDER BY g LIMIT 10",
+    "SELECT g, SUM(v) FROM t WHERE g = 'g3' GROUP BY g LIMIT 5",
+    "SET maxDenseGroups = 2; SELECT g, COUNT(*), SUM(v) FROM t GROUP BY g ORDER BY g LIMIT 10",
+    "SELECT day, v FROM t WHERE v > 800 ORDER BY day, v LIMIT 20",
+]
+
+
+def _run_all(eng):
+    out = []
+    for q in QUERIES:
+        r = eng.query(q)
+        out.append(r.rows)
+    return out
+
+
+def test_batched_matches_unbatched(engines):
+    """Every query kind returns identical rows under forced tiny launches."""
+    base = _run_all(engines(None))
+    # ~5 bytes/doc * 160 docs/shard = 800 bytes/device; 300 forces 3 launches
+    batched_eng = engines(300)
+    got = _run_all(batched_eng)
+    assert got == base
+    # prove batching actually happened (and exercised a ragged tail or not,
+    # but at minimum multiple launches)
+    ctx = parse_query(QUERIES[0])
+    plan = batched_eng._plan(ctx, batched_eng.tables["t"])
+    assert len(plan.batch_offsets) >= 2
+    assert plan.batch_docs < batched_eng.tables["t"].docs_per_shard
+
+
+def test_ragged_tail_fresh_masking(engines):
+    """When batch width does not divide D, the tail re-launches the last
+    full-width window with covered rows masked off — no double counting."""
+    eng = engines(300)
+    st = eng.tables["t"]
+    ctx = parse_query("SELECT COUNT(*), SUM(v) FROM t")
+    plan = eng._plan(ctx, st)
+    D = st.docs_per_shard
+    covered = sorted((off, off + plan.batch_docs) for off, _ in plan.batch_offsets)
+    assert covered[0][0] == 0 and covered[-1][1] == D
+    # exact COUNT proves no row is counted twice across overlapping windows
+    r = eng.query("SELECT COUNT(*) FROM t")
+    assert r.rows[0][0] == N
+    if any(fresh for _, fresh in plan.batch_offsets):
+        # tail overlap present: SUM must still be exact
+        v_sum = eng.query("SELECT SUM(v) FROM t").rows[0][0]
+        base = engines(None).query("SELECT SUM(v) FROM t").rows[0][0]
+        assert v_sum == base
+
+
+def test_docrange_filter_across_batches(engines):
+    """Sorted-column doc ranges are GLOBAL doc ids; the per-launch __boff__
+    offset must line them up with each batch's rows."""
+    base_eng = engines(None)
+    # a filter-only COUNT ships no columns, so the byte estimate is just the
+    # 1-byte floor — 100 bytes/launch still forces 2 launches at D=160
+    eng = engines(100)
+    for hi in (10, 57, 123, 199):
+        q = f"SELECT COUNT(*), SUM(v) FROM t WHERE day < {hi}"
+        assert eng.query(q).rows == base_eng.query(q).rows
+    ctx = parse_query("SELECT COUNT(*) FROM t WHERE day < 57")
+    plan = eng._plan(ctx, eng.tables["t"])
+    assert ("day", "sorted") in plan.index_uses
+    assert len(plan.batch_offsets) >= 2
+
+
+def test_bitmap_words_slice_per_batch(engines):
+    """Inverted-index words ship [ndev, L*Db//32] slices per launch."""
+    eng = engines(300)
+    st = eng.tables["t"]
+    ctx = parse_query("SELECT COUNT(*) FROM t WHERE g = 'g1'")
+    plan = eng._plan(ctx, st)
+    assert ("g", "inverted") in plan.index_uses
+    assert plan.row_sharded_params
+    key = next(iter(plan.row_sharded_params))
+    ndev = eng.num_devices
+    L = st.num_shards // ndev
+    assert plan.params[key].shape == (ndev, L, st.docs_per_shard // 32)
+    for off, fresh in plan.batch_offsets:
+        bp = eng.batch_params(plan, off, fresh)
+        assert bp[key].shape == (ndev, L * plan.batch_docs // 32)
+        assert bp["__boff__"] == off and bp["__fresh__"] == fresh
+    base = engines(None).query("SELECT COUNT(*) FROM t WHERE g = 'g1'").rows
+    assert eng.query("SELECT COUNT(*) FROM t WHERE g = 'g1'").rows == base

@@ -1,0 +1,529 @@
+"""Aggregation long tail: HISTOGRAM, covariance family, EXPR_MIN/EXPR_MAX
+(argmin/argmax), FREQUENTSTRINGS, and the integer tuple sketch family.
+
+Reference parity (VERDICT r4 #8 / missing #3):
+  * HISTOGRAM -> pinot-core/.../function/HistogramAggregationFunction.java:
+    `HISTOGRAM(col, lower, upper, numBins)` equal-width bins, or
+    `HISTOGRAM(col, '0,1,10,100')` explicit edges (the reference's
+    ARRAY[0,1,10,100] spelled as a literal string — this parser has no
+    ARRAY constructor).  Bins are [e_i, e_{i+1}) with the last bin closed;
+    out-of-range values are dropped.  Device form: bucket ids via a
+    broadcast edge compare, then the shared group_count scatter — one
+    additive [bins] tensor partial, psum-able.
+  * COVAR_POP/COVAR_SAMP/CORR -> CovarianceAggregationFunction.java's
+    CovarianceTuple (sumX, sumY, sumXY, count) re-designed as additive
+    field dicts so the in-graph psum combine and the sparse slot kernel
+    both apply.  CORR adds sumsqx/sumsqy (PearsonCorrelation tuple).
+  * EXPR_MIN/EXPR_MAX -> ParentExprMinMaxAggregationFunction.java:
+    `EXPR_MIN(projection, measure)` returns the projection value at the
+    extremal measure.  One measuring + one numeric projection column here
+    (the reference supports lists); ties on the measure break to the
+    LARGEST projection value (deterministic; the reference returns an
+    arbitrary tied row).  Partials carry the coupled (m, v) pair and merge
+    pairwise, like FIRST/LAST_WITH_TIME.
+  * FREQUENTSTRINGS -> FrequentStringsSketchAggregationFunction.java:
+    exact top-k over dictionary codes (FREQUENTLONGS' histogram on the
+    shared code space) decoded through the dictionary at final — exact
+    where the reference's ItemsSketch is approximate.
+  * DISTINCTCOUNTTUPLESKETCH / SUMVALUESINTEGERSUMTUPLESKETCH /
+    AVGVALUEINTEGERSUMTUPLESKETCH -> IntegerTupleSketchAggregationFunction
+    .java + SumValues/AvgValue siblings: a KMV sketch that carries an
+    int64 summary per retained hash, summing summaries of duplicate keys
+    (the datasketches Tuple "integer sum" mode).  Device form: one sort by
+    (group, hash) + run-boundary flags gives distinct ranks AND per-key
+    payload segment sums; the K smallest distinct hashes and their sums
+    scatter into fixed [K] tables.  Merge is pairwise (hash-aligned
+    payload add), estimates scale by 1/theta exactly like the reference.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu import ops
+from pinot_tpu.query.functions import AggFunction, register
+from pinot_tpu.query.sketches import ColumnBinding, _check_cell_budget
+from pinot_tpu.query.aggs_extra import FrequentLongsFunction
+
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+
+
+# ---------------------------------------------------------------------------
+# HISTOGRAM
+# ---------------------------------------------------------------------------
+class HistogramFunction(AggFunction):
+    name = "histogram"
+    vector_fields = True
+    fields = ("hist",)
+
+    def __init__(self, edges: Optional[np.ndarray] = None, equal_width: bool = False):
+        self.edges = None if edges is None else np.asarray(edges, dtype=np.float64)
+        self.equal_width = equal_width
+
+    def with_args(self, literal_args):
+        if len(literal_args) == 1:
+            s = str(literal_args[0]).strip()
+            if s.upper().startswith("ARRAY"):
+                s = s[5:].strip()
+            edges = np.asarray([float(x) for x in s.strip("[]() ").split(",")], np.float64)
+            eq = False
+        elif len(literal_args) == 3:
+            lo, hi, bins = (float(literal_args[0]), float(literal_args[1]), int(literal_args[2]))
+            if bins <= 0 or hi <= lo:
+                raise ValueError(f"HISTOGRAM needs upper > lower and numBins > 0, got {literal_args}")
+            edges = np.linspace(lo, hi, bins + 1)
+            eq = True
+        else:
+            raise ValueError(
+                "HISTOGRAM takes (col, lower, upper, numBins) or (col, '<edge,edge,...>'), "
+                f"got {len(literal_args) + 1} arguments"
+            )
+        if len(edges) < 2 or np.any(np.diff(edges) <= 0):
+            raise ValueError(f"HISTOGRAM bin edges must be strictly increasing, got {edges}")
+        return HistogramFunction(edges, eq)
+
+    @property
+    def width(self) -> int:
+        return len(self.edges) - 1
+
+    def _bucket(self, values):
+        """(bucket ids, in-range mask).  Bins are [e_i, e_{i+1}), last bin
+        closed at the top (HistogramAggregationFunction semantics).  The
+        compare runs f64 on CPU ('wide' policy) and f32 on TPU — edge-exact
+        for int edges below 2^24 there; beyond that edge placement has f32
+        precision (documented TPU trade)."""
+        import jax.numpy as jnp
+
+        dt = jnp.float64 if ops.accum_policy() == "wide" else jnp.float32
+        v = values.astype(dt)
+        e = jnp.asarray(self.edges, dt)
+        inb = (v >= e[0]) & (v <= e[-1])
+        # searchsorted over interior edges: O(n log bins), no [n, bins]
+        # broadcast intermediate; top edge folds into the last bin
+        b = jnp.searchsorted(e[1:-1], v, side="right").astype(jnp.int32)
+        return b, inb
+
+    def partial(self, values, mask):
+        b, inb = self._bucket(values)
+        return {"hist": ops.group_count(mask & inb, b, self.width)}
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        _check_cell_budget(self.name, num_groups, self.width)
+        b, inb = self._bucket(values)
+        flat = keys.astype(np.int32) * np.int32(self.width) + b
+        return {
+            "hist": ops.group_count(mask & inb, flat, num_groups * self.width).reshape(
+                num_groups, self.width
+            )
+        }
+
+    def merge(self, a, b):
+        return {"hist": np.asarray(a["hist"]) + np.asarray(b["hist"])}
+
+    def final(self, p):
+        hist = np.asarray(p["hist"], dtype=np.float64)
+        one = hist.ndim == 1
+        hist = np.atleast_2d(hist)
+        out = np.empty(hist.shape[0], dtype=object)
+        for g in range(hist.shape[0]):
+            out[g] = [float(c) for c in hist[g]]
+        return out[0] if one else out
+
+    def final_dtype(self):
+        return np.dtype(object)
+
+
+# ---------------------------------------------------------------------------
+# COVAR_POP / COVAR_SAMP / CORR
+# ---------------------------------------------------------------------------
+class CovarianceFunction(AggFunction):
+    """COVAR_POP(x, y): E[XY] - E[X]E[Y] over matching rows.  The partial is
+    the CovarianceTuple as an additive field dict; products accumulate f64
+    on CPU and f32 on TPU (documented float contract, like f32_sum)."""
+
+    name = "covar_pop"
+    needs_extra_exprs = True
+    fields = ("count", "sumx", "sumy", "sumxy")
+    sample = False
+
+    def _floats(self, values):
+        import jax.numpy as jnp
+
+        dt = jnp.float64 if ops.accum_policy() == "wide" else jnp.float32
+        x, y = values[0], values[1]
+        return x.astype(dt), y.astype(dt)
+
+    def partial(self, values, mask):
+        x, y = self._floats(values)
+        return {
+            "count": ops.masked_count(mask),
+            "sumx": ops.masked_sum(x, mask),
+            "sumy": ops.masked_sum(y, mask),
+            "sumxy": ops.masked_sum(x * y, mask),
+        }
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        x, y = self._floats(values)
+        return {
+            "count": ops.group_count(mask, keys, num_groups),
+            "sumx": ops.group_sum(x, mask, keys, num_groups),
+            "sumy": ops.group_sum(y, mask, keys, num_groups),
+            "sumxy": ops.group_sum(x * y, mask, keys, num_groups),
+        }
+
+    def merge(self, a, b):
+        return {k: np.asarray(a[k]) + np.asarray(b[k]) for k in self.fields}
+
+    def final(self, p):
+        n = np.asarray(p["count"], dtype=np.float64)
+        sx = np.asarray(p["sumx"], dtype=np.float64)
+        sy = np.asarray(p["sumy"], dtype=np.float64)
+        sxy = np.asarray(p["sumxy"], dtype=np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            cov = sxy / n - (sx / n) * (sy / n)
+            if self.sample:
+                return np.where(n > 1, cov * n / (n - 1), np.nan)
+            return np.where(n > 0, cov, np.nan)
+
+
+class CovarianceSampFunction(CovarianceFunction):
+    name = "covar_samp"
+    sample = True
+
+
+class CorrelationFunction(CovarianceFunction):
+    """CORR(x, y): Pearson correlation (reference CovarianceAggregationFunction
+    sibling tuple with sum-of-squares fields)."""
+
+    name = "corr"
+    fields = ("count", "sumx", "sumy", "sumxy", "sumsqx", "sumsqy")
+    sample = False
+
+    def partial(self, values, mask):
+        x, y = self._floats(values)
+        p = CovarianceFunction.partial(self, values, mask)
+        p["sumsqx"] = ops.masked_sum(x * x, mask)
+        p["sumsqy"] = ops.masked_sum(y * y, mask)
+        return p
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        x, y = self._floats(values)
+        p = CovarianceFunction.partial_grouped(self, values, mask, keys, num_groups)
+        p["sumsqx"] = ops.group_sum(x * x, mask, keys, num_groups)
+        p["sumsqy"] = ops.group_sum(y * y, mask, keys, num_groups)
+        return p
+
+    def final(self, p):
+        n = np.asarray(p["count"], dtype=np.float64)
+        sx = np.asarray(p["sumx"], dtype=np.float64)
+        sy = np.asarray(p["sumy"], dtype=np.float64)
+        sxy = np.asarray(p["sumxy"], dtype=np.float64)
+        ssx = np.asarray(p["sumsqx"], dtype=np.float64)
+        ssy = np.asarray(p["sumsqy"], dtype=np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            covn = sxy - sx * sy / n
+            varxn = ssx - sx * sx / n
+            varyn = ssy - sy * sy / n
+            return np.where(
+                (n > 0) & (varxn > 0) & (varyn > 0), covn / np.sqrt(varxn * varyn), np.nan
+            )
+
+
+# ---------------------------------------------------------------------------
+# EXPR_MIN / EXPR_MAX (argmin / argmax)
+# ---------------------------------------------------------------------------
+class ExprMaxFunction(AggFunction):
+    """EXPR_MAX(projection, measure): projection value at the max measure.
+    values arrives as (projection, measure) via extra_exprs.  Numeric
+    projections only (the reference also projects strings); measure ties
+    take the max projection value."""
+
+    name = "exprmax"
+    needs_extra_exprs = True
+    vector_fields = True  # coupled fields: keep off generic field combines
+    pairwise_merge = True
+    fields = ("m", "v")
+    pick_max = True
+
+    def _prep(self, values, mask):
+        import jax.numpy as jnp
+
+        v, m = values[0], values[1]
+        sign = 1.0 if self.pick_max else -1.0
+        mm = jnp.where(mask, m.astype(jnp.float64) * sign, -jnp.inf)
+        return v.astype(jnp.float64), mm, sign
+
+    def partial(self, values, mask):
+        import jax.numpy as jnp
+
+        v, mm, sign = self._prep(values, mask)
+        mbest = jnp.max(mm)
+        best = mask & (mm == mbest)
+        return {"m": mbest * sign, "v": jnp.max(jnp.where(best, v, -jnp.inf))}
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        import jax.numpy as jnp
+
+        v, mm, sign = self._prep(values, mask)
+        k = keys.astype(jnp.int32)
+        mbest = jnp.full((num_groups,), -jnp.inf).at[k].max(jnp.where(mask, mm, -jnp.inf), mode="drop")
+        best = mask & (mm == mbest[k])
+        vbest = jnp.full((num_groups,), -jnp.inf).at[k].max(jnp.where(best, v, -jnp.inf), mode="drop")
+        return {"m": mbest * sign, "v": vbest}
+
+    def merge(self, a, b):
+        sign = 1.0 if self.pick_max else -1.0
+        am, bm = np.asarray(a["m"], np.float64) * sign, np.asarray(b["m"], np.float64) * sign
+        av, bv = np.asarray(a["v"], np.float64), np.asarray(b["v"], np.float64)
+        take_b = (bm > am) | ((bm == am) & (bv > av))
+        return {"m": np.where(take_b, b["m"], a["m"]), "v": np.where(take_b, bv, av)}
+
+    def final(self, p):
+        m = np.asarray(p["m"], dtype=np.float64)
+        return np.where(np.isfinite(m), np.asarray(p["v"], np.float64), np.nan)
+
+
+class ExprMinFunction(ExprMaxFunction):
+    name = "exprmin"
+    pick_max = False
+
+
+# ---------------------------------------------------------------------------
+# FREQUENTSTRINGS: exact top-k over dictionary codes
+# ---------------------------------------------------------------------------
+class FrequentStringsFunction(FrequentLongsFunction):
+    name = "frequentstrings"
+    input_kind = "codes"
+
+    def __init__(self, domain: int = 0, k: int = 10, dict_values: Optional[np.ndarray] = None):
+        # base 0: codes ARE the offsets on the shared dictionary key space
+        FrequentLongsFunction.__init__(self, domain=domain, base=0, k=k)
+        self.dict_values = dict_values
+
+    def with_args(self, literal_args):
+        k = int(literal_args[0]) if literal_args else 10
+        return FrequentStringsFunction(k=k)
+
+    def bind_column(self, info: ColumnBinding):
+        if info.kind != "dict" or info.dict_values is None:
+            raise NotImplementedError(
+                "FREQUENTSTRINGS requires a dictionary-encoded column with a "
+                "shared key space across segments"
+            )
+        return FrequentStringsFunction(domain=info.domain, k=self.k, dict_values=info.dict_values)
+
+    def bind_reduce(self, ctx, spec):
+        """final() decodes codes through the dictionary, which the reduce-side
+        registry singleton lacks — the engines inject it as a ctx option
+        (__dictvals__<col>, set alongside __dictfp__)."""
+        dv = ctx.options.get(f"__dictvals__{spec.expr.op}") if spec.expr is not None else None
+        if dv is None:
+            raise NotImplementedError(
+                "FREQUENTSTRINGS reduce needs engine-injected dictionary values "
+                "(__dictvals__ option missing)"
+            )
+        return FrequentStringsFunction(k=self.k, dict_values=dv)
+
+    def final(self, p):
+        hist = np.atleast_2d(np.asarray(p["hist"]))
+        out = np.empty(hist.shape[0], dtype=object)
+        for g in range(hist.shape[0]):
+            nz = np.nonzero(hist[g])[0]
+            top = nz[np.argsort(-hist[g][nz], kind="stable")][: self.k]
+            out[g] = [str(self.dict_values[c]) for c in top]
+        return out[0] if np.asarray(p["hist"]).ndim == 1 else out
+
+
+# ---------------------------------------------------------------------------
+# Integer tuple sketch: KMV + int64 summary per retained hash
+# ---------------------------------------------------------------------------
+class IntegerTupleSketchFunction(AggFunction):
+    """DISTINCTCOUNTTUPLESKETCH(key, value): KMV over key hashes where each
+    retained hash carries the SUM of its rows' int values (datasketches
+    integer-sum Tuple mode).  final() dispatches on `estimate`:
+      distinct -> (K-1)/theta distinct-key estimate
+      sum      -> sum(retained summaries)/theta (SumValuesIntegerSumTuple)
+      avg      -> mean retained summary (AvgValueIntegerSumTuple)."""
+
+    name = "distinctcounttuplesketch"
+    needs_codes = True
+    needs_binding = True
+    needs_extra_exprs = True
+    vector_fields = True
+    pairwise_merge = True
+    input_kind = "values_hash"
+    fields = ("kmv", "pay")
+    estimate = "distinct"
+
+    K = 4096
+    GROUPED_K = 256
+
+    def bind_column(self, info: ColumnBinding):
+        return self  # hash-based
+
+    def _hash(self, values):
+        import jax.numpy as jnp
+
+        from pinot_tpu.query.sketches import _device_hash32, _device_hash_values
+
+        h1 = _device_hash_values(values)
+        h2 = _device_hash32(h1 ^ np.uint32(0x9E3779B9))
+        return ((h1 & np.uint32(0x7FFFFFFF)).astype(jnp.int64) << np.int64(31)) | (
+            h2 >> np.uint32(1)
+        ).astype(jnp.int64)
+
+    def partial(self, values, mask):
+        return {k: t[0] for k, t in self.partial_grouped(values, mask, None, 1).items()}
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        """One sort by (group, hash) yields distinct ranks AND per-key
+        payload segment sums (prefix-sum difference at run boundaries)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        v, pay = values[0], values[1]
+        if num_groups == 1:
+            kk = self.K
+            gk = jnp.where(mask, np.int32(0), np.int32(1))
+        else:
+            kk = max(16, min(self.GROUPED_K, 2_000_000 // max(1, num_groups)))
+            gk = jnp.where(mask, keys.astype(jnp.int32), np.int32(num_groups))
+        _check_cell_budget(self.name, num_groups, kk)
+        n = mask.shape[0]
+        h = jnp.where(mask, self._hash(v), _I64_MAX)
+        payf = jnp.where(mask, pay.astype(jnp.float64), 0.0)
+        iota = jnp.arange(n, dtype=jnp.int32)
+        s_k, s_h, perm = lax.sort((gk, h, iota), num_keys=2)
+        s_pay = payf[perm]
+        prev_k = jnp.concatenate([jnp.full((1,), -1, s_k.dtype), s_k[:-1]])
+        prev_h = jnp.concatenate([jnp.full((1,), -1, s_h.dtype), s_h[:-1]])
+        grp_start = s_k != prev_k
+        new = (grp_start | (s_h != prev_h)) & (s_k < num_groups) & (s_h != _I64_MAX)
+        c = jnp.cumsum(new.astype(jnp.int32))
+        base = lax.cummax(jnp.where(grp_start, c - new.astype(jnp.int32), 0))
+        rank = c - 1 - base
+        # per-key payload sum: prefix sums differenced between run starts
+        p0 = jnp.concatenate([jnp.zeros((1,), jnp.float64), jnp.cumsum(s_pay)])
+        starts_at = jnp.where(new, iota, np.int32(n))
+        nxt_ge = lax.cummin(starts_at[::-1])[::-1]
+        nxt_start = jnp.concatenate([nxt_ge[1:], jnp.full((1,), n, jnp.int32)])
+        # at a run start i: sum of s_pay[i : next run start); elsewhere unused
+        run_end = jnp.where(
+            nxt_start >= n, np.int32(n), nxt_start
+        )
+        run_sum = p0[run_end] - p0[iota]
+        cells = num_groups * kk
+        slot = jnp.where(new & (rank < kk), s_k * np.int32(kk) + rank, np.int32(cells))
+        kmv = (
+            jnp.full((cells + 1,), _I64_MAX, dtype=jnp.int64)
+            .at[slot]
+            .set(s_h)[:cells]
+            .reshape(num_groups, kk)
+        )
+        pays = (
+            jnp.zeros((cells + 1,), jnp.float64)
+            .at[slot]
+            .set(run_sum)[:cells]
+            .reshape(num_groups, kk)
+        )
+        return {"kmv": kmv, "pay": pays}
+
+    def merge(self, a, b):
+        """Hash-aligned pairwise merge: concat along the K axis, sort by
+        hash, fold duplicate neighbors' payloads left, keep the K smallest."""
+        ak, bk = np.asarray(a["kmv"]), np.asarray(b["kmv"])
+        ap, bp = np.asarray(a["pay"], np.float64), np.asarray(b["pay"], np.float64)
+        x = np.concatenate([ak, bk], axis=-1)
+        p = np.concatenate([ap, bp], axis=-1)
+        order = np.argsort(x, axis=-1, kind="stable")
+        x = np.take_along_axis(x, order, -1)
+        p = np.take_along_axis(p, order, -1)
+        dup = np.zeros_like(x, dtype=bool)
+        dup[..., 1:] = x[..., 1:] == x[..., :-1]
+        # fold payload of duplicates into the first of each equal run
+        # (runs have length <= 2: each side holds distinct hashes)
+        carry = np.where(dup, p, 0.0)
+        p = p + np.roll(carry, -1, axis=-1)
+        p = np.where(dup, 0.0, p)
+        x = np.where(dup, _I64_MAX, x)
+        order = np.argsort(x, axis=-1, kind="stable")
+        x = np.take_along_axis(x, order, -1)
+        p = np.take_along_axis(p, order, -1)
+        k = min(ak.shape[-1], bk.shape[-1])
+        return {"kmv": x[..., :k], "pay": p[..., :k]}
+
+    def final(self, p):
+        kmv = np.asarray(p["kmv"])
+        pay = np.asarray(p["pay"], dtype=np.float64)
+        one = kmv.ndim == 1
+        kmv = np.atleast_2d(kmv)
+        pay = np.atleast_2d(pay)
+        k = kmv.shape[-1]
+        valid = kmv != _I64_MAX
+        n_v = valid.sum(axis=-1)
+        kth = kmv[..., -1].astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            theta = np.where(n_v < k, 1.0, kth / float(1 << 62))
+            if self.estimate == "distinct":
+                out = np.where(n_v < k, n_v, (n_v - 1) / theta)
+            elif self.estimate == "sum":
+                psum = np.where(valid, pay, 0.0)
+                # saturated: drop the theta-defining Kth entry like the
+                # distinct estimator, scale by 1/theta
+                psum = np.where(
+                    (n_v < k)[..., None], psum, np.where(
+                        np.arange(k)[None, :] < k - 1, psum, 0.0
+                    ),
+                )
+                out = psum.sum(axis=-1) / theta
+            else:  # avg summary value among retained keys
+                cnt = np.where(n_v < k, n_v, n_v - 1)
+                psum = np.where(valid, pay, 0.0).sum(axis=-1)
+                psum = np.where(n_v < k, psum, psum - np.where(valid[..., -1], pay[..., -1], 0.0))
+                out = np.where(cnt > 0, psum / np.maximum(cnt, 1), np.nan)
+        return out[0] if one else out
+
+    def final_dtype(self):
+        return np.dtype(np.int64) if self.estimate == "distinct" else np.dtype(np.float64)
+
+
+class SumValuesTupleSketchFunction(IntegerTupleSketchFunction):
+    name = "sumvaluesintegersumtuplesketch"
+    estimate = "sum"
+
+
+class AvgValueTupleSketchFunction(IntegerTupleSketchFunction):
+    name = "avgvalueintegersumtuplesketch"
+    estimate = "avg"
+
+
+for _cls in (
+    HistogramFunction,
+    CovarianceFunction,
+    CovarianceSampFunction,
+    CorrelationFunction,
+    ExprMaxFunction,
+    ExprMinFunction,
+    FrequentStringsFunction,
+    IntegerTupleSketchFunction,
+    SumValuesTupleSketchFunction,
+    AvgValueTupleSketchFunction,
+):
+    register(_cls())
+
+from pinot_tpu.query.functions import _REGISTRY  # noqa: E402
+
+# reference exposes both spellings
+for _alias, _target in (
+    ("expr_max", "exprmax"),
+    ("expr_min", "exprmin"),
+    ("argmax", "exprmax"),
+    ("argmin", "exprmin"),
+    ("arg_max", "exprmax"),
+    ("arg_min", "exprmin"),
+    ("covarpop", "covar_pop"),
+    ("covarsamp", "covar_samp"),
+):
+    _REGISTRY[_alias] = _REGISTRY[_target]

@@ -210,11 +210,14 @@ class QueryEngine:
                 continue
             mins, maxs = [], []
             fps = set()
+            dict_values = None
             for seg in segments:
                 if col not in seg.columns:
                     continue
                 c = seg.column(col)
                 fps.add(c.dictionary.fingerprint() if c.has_dictionary else None)
+                if c.has_dictionary and dict_values is None:
+                    dict_values = c.dictionary.values
                 if c.stats.min_value is not None and not c.data_type.is_string_like:
                     mins.append(c.stats.min_value)
                     maxs.append(c.stats.max_value)
@@ -223,6 +226,10 @@ class QueryEngine:
             if fps:
                 only = next(iter(fps)) if len(fps) == 1 else None
                 ctx.options.setdefault(fkey, "MIXED" if len(fps) > 1 else (only or ""))
+                if len(fps) == 1 and dict_values is not None:
+                    # shared key space: reduce-time decode (bind_reduce) may
+                    # need the dictionary values themselves
+                    ctx.options.setdefault(f"__dictvals__{col}", dict_values)
 
     def query(self, sql: str, device=None) -> ResultTable:
         """SQL front door (CalciteSqlParser analog lives in sql/)."""

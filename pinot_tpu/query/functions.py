@@ -43,6 +43,12 @@ FIELD_COMBINE = {
     "hist": "add",
     "lo": "min",
     "hi": "max",
+    # covariance tuple fields (query/aggs_stats.py) — all additive
+    "sumx": "add",
+    "sumy": "add",
+    "sumxy": "add",
+    "sumsqx": "add",
+    "sumsqy": "add",
 }
 
 
@@ -94,6 +100,13 @@ class AggFunction:
 
     def bind_column(self, info) -> "AggFunction":
         """Bind per-column constants (domain, hash tables, bin ranges)."""
+        return self
+
+    def bind_reduce(self, ctx, spec) -> "AggFunction":
+        """Bind REDUCE-time constants from engine-injected ctx options (e.g.
+        FREQUENTSTRINGS' dictionary values for final-step decode).  Called on
+        the registry singleton at broker reduce, where plan-side bind_column
+        results are not available."""
         return self
 
     # -- device: per-segment partials -----------------------------------
@@ -380,3 +393,7 @@ from pinot_tpu.query import sketches  # noqa: E402,F401
 # Extended aggregations (KLL log-sketch, theta, MODE, FIRST/LAST_WITH_TIME);
 # must import AFTER sketches: percentilekll overrides the histogram stand-in
 from pinot_tpu.query import aggs_extra  # noqa: E402,F401
+
+# Statistics long tail (HISTOGRAM, covariance family, EXPR_MIN/MAX,
+# FREQUENTSTRINGS, integer tuple sketches) — after aggs_extra (subclasses)
+from pinot_tpu.query import aggs_stats  # noqa: E402,F401

@@ -53,7 +53,7 @@ def reduce_results(ctx: QueryContext, results: List[Any], stats: ExecutionStats)
 # Aggregation-only
 # ---------------------------------------------------------------------------
 def _reduce_aggregation(ctx: QueryContext, results: List[AggSegmentResult], stats: ExecutionStats) -> ResultTable:
-    aggs = [for_spec(a) for a in ctx.aggregations]
+    aggs = [for_spec(a).bind_reduce(ctx, a) for a in ctx.aggregations]
     merged: Optional[List[Dict[str, np.ndarray]]] = None
     for r in results:
         if merged is None:
@@ -109,7 +109,7 @@ def _register_agg_env(env: Dict[str, Any], spec: AggregationSpec, finals) -> Non
 # Group-by
 # ---------------------------------------------------------------------------
 def _reduce_groupby(ctx: QueryContext, results: List[GroupBySegmentResult], stats: ExecutionStats) -> ResultTable:
-    aggs = [for_spec(a) for a in ctx.aggregations]
+    aggs = [for_spec(a).bind_reduce(ctx, a) for a in ctx.aggregations]
     results = [r for r in results if r is not None]
     if not results:
         return ResultTable(columns=ctx.column_names_out(), rows=[], stats=stats)

@@ -1,0 +1,240 @@
+"""Statistics long-tail aggregations (VERDICT r4 #8): HISTOGRAM, covariance
+family, EXPR_MIN/EXPR_MAX, FREQUENTSTRINGS, integer tuple sketches.
+
+Reference model: HistogramAggregationFunction (bin semantics: [e, e') bins,
+last bin closed, out-of-range dropped), CovarianceAggregationFunction
+(CovarianceTuple merge), ParentExprMinMaxAggregationFunction,
+FrequentStringsSketchAggregationFunction, IntegerTupleSketchAggregationFunction.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+N = 40_000
+
+
+def _make_engine(data, schema, n_segments=3):
+    eng = QueryEngine()
+    eng.register_table(schema)
+    n = len(next(iter(data.values())))
+    bounds = np.linspace(0, n, n_segments + 1).astype(int)
+    for i in range(n_segments):
+        chunk = {k: v[bounds[i] : bounds[i + 1]] for k, v in data.items()}
+        eng.add_segment(schema.name, build_segment(schema, chunk, f"s{i}"))
+    return eng
+
+
+@pytest.fixture(scope="module")
+def xy_engine():
+    rng = np.random.default_rng(23)
+    g = rng.integers(0, 4, N).astype(np.int32)
+    x = rng.normal(0, 10, N)
+    y = 3.0 * x + rng.normal(0, 5, N) + g
+    m = rng.integers(0, 1_000_000, N).astype(np.int64)
+    schema = Schema(
+        "xy",
+        [
+            FieldSpec("g", DataType.INT),
+            FieldSpec("x", DataType.DOUBLE, role=FieldRole.METRIC),
+            FieldSpec("y", DataType.DOUBLE, role=FieldRole.METRIC),
+            FieldSpec("m", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+    data = {"g": g, "x": x, "y": y, "m": m}
+    return _make_engine(data, schema), data
+
+
+class TestHistogram:
+    def test_equal_width(self, xy_engine):
+        eng, data = xy_engine
+        res = eng.query("SELECT HISTOGRAM(x, -30, 30, 6) FROM xy")
+        got = np.asarray(res.rows[0][0], dtype=np.float64)
+        edges = np.linspace(-30, 30, 7)
+        x = data["x"]
+        want = np.histogram(x[(x >= -30) & (x <= 30)], bins=edges)[0]
+        assert got.shape == (6,)
+        np.testing.assert_allclose(got, want)
+
+    def test_explicit_edges_and_last_bin_closed(self):
+        vals = np.asarray([0.0, 0.5, 1.0, 5.0, 9.0, 10.0, 11.0, -1.0])
+        schema = Schema("h", [FieldSpec("v", DataType.DOUBLE, role=FieldRole.METRIC)])
+        eng = _make_engine({"v": vals}, schema, n_segments=2)
+        got = np.asarray(eng.query("SELECT HISTOGRAM(v, '0,1,10') FROM h").rows[0][0])
+        # bins [0,1), [1,10]; 10.0 joins the last bin; 11.0 and -1.0 drop
+        np.testing.assert_allclose(got, [2, 4])
+
+    def test_grouped(self, xy_engine):
+        eng, data = xy_engine
+        res = eng.query("SELECT g, HISTOGRAM(x, -30, 30, 6) FROM xy GROUP BY g ORDER BY g")
+        edges = np.linspace(-30, 30, 7)
+        for row in res.rows:
+            sel = data["g"] == int(row[0])
+            x = data["x"][sel]
+            want = np.histogram(x[(x >= -30) & (x <= 30)], bins=edges)[0]
+            np.testing.assert_allclose(np.asarray(row[1], np.float64), want)
+
+
+class TestCovariance:
+    def test_covar_pop_samp_corr(self, xy_engine):
+        eng, data = xy_engine
+        x, y = data["x"], data["y"]
+        res = eng.query("SELECT COVAR_POP(x, y), COVAR_SAMP(x, y), CORR(x, y) FROM xy")
+        want_pop = np.cov(x, y, bias=True)[0, 1]
+        want_samp = np.cov(x, y, bias=False)[0, 1]
+        want_corr = np.corrcoef(x, y)[0, 1]
+        np.testing.assert_allclose(float(res.rows[0][0]), want_pop, rtol=1e-9)
+        np.testing.assert_allclose(float(res.rows[0][1]), want_samp, rtol=1e-9)
+        np.testing.assert_allclose(float(res.rows[0][2]), want_corr, rtol=1e-9)
+
+    def test_grouped_covariance(self, xy_engine):
+        eng, data = xy_engine
+        res = eng.query("SELECT g, COVAR_POP(x, y) FROM xy GROUP BY g ORDER BY g")
+        for row in res.rows:
+            sel = data["g"] == int(row[0])
+            want = np.cov(data["x"][sel], data["y"][sel], bias=True)[0, 1]
+            np.testing.assert_allclose(float(row[1]), want, rtol=1e-9)
+
+    def test_filtered_covariance(self, xy_engine):
+        eng, data = xy_engine
+        res = eng.query("SELECT COVAR_POP(x, y) FROM xy WHERE g = 2")
+        sel = data["g"] == 2
+        want = np.cov(data["x"][sel], data["y"][sel], bias=True)[0, 1]
+        np.testing.assert_allclose(float(res.rows[0][0]), want, rtol=1e-9)
+
+
+class TestExprMinMax:
+    def test_scalar(self, xy_engine):
+        eng, data = xy_engine
+        res = eng.query("SELECT EXPR_MAX(x, m), EXPR_MIN(x, m), ARG_MAX(x, m) FROM xy")
+        want_max = data["x"][np.argmax(data["m"])]
+        want_min = data["x"][np.argmin(data["m"])]
+        # ties on m are possible with random int64s but vanishingly unlikely
+        np.testing.assert_allclose(float(res.rows[0][0]), want_max)
+        np.testing.assert_allclose(float(res.rows[0][1]), want_min)
+        np.testing.assert_allclose(float(res.rows[0][2]), want_max)
+
+    def test_grouped(self, xy_engine):
+        eng, data = xy_engine
+        res = eng.query("SELECT g, EXPR_MIN(y, m) FROM xy GROUP BY g ORDER BY g")
+        for row in res.rows:
+            sel = np.nonzero(data["g"] == int(row[0]))[0]
+            want = data["y"][sel[np.argmin(data["m"][sel])]]
+            np.testing.assert_allclose(float(row[1]), want)
+
+    def test_empty_filter_is_null(self, xy_engine):
+        eng, _ = xy_engine
+        res = eng.query("SELECT EXPR_MAX(x, m) FROM xy WHERE g = 99")
+        v = res.rows[0][0]
+        assert v is None or (isinstance(v, float) and np.isnan(v))
+
+
+class TestFrequentStrings:
+    def test_top_k(self):
+        rng = np.random.default_rng(3)
+        # zipf-ish frequencies over 20 city names
+        names = np.asarray([f"city{i:02d}" for i in range(20)])
+        weights = 1.0 / np.arange(1, 21)
+        weights /= weights.sum()
+        vals = rng.choice(names, size=N, p=weights)
+        schema = Schema("c", [FieldSpec("city", DataType.STRING)])
+        eng = _make_engine({"city": vals}, schema)
+        got = eng.query("SELECT FREQUENTSTRINGS(city, 5) FROM c").rows[0][0]
+        uniq, counts = np.unique(vals, return_counts=True)
+        want = list(uniq[np.argsort(-counts, kind="stable")][:5])
+        assert got == [str(w) for w in want]
+
+    def test_grouped(self):
+        rng = np.random.default_rng(9)
+        g = rng.integers(0, 3, 9000)
+        # group i's most common value is f"v{i}"
+        vals = np.asarray([f"v{x}" if rng.random() < 0.5 else f"v{rng.integers(0, 9)}" for x in g])
+        schema = Schema("fs", [FieldSpec("g", DataType.INT), FieldSpec("v", DataType.STRING)])
+        eng = _make_engine({"g": g, "v": vals}, schema)
+        res = eng.query("SELECT g, FREQUENTSTRINGS(v, 1) FROM fs GROUP BY g ORDER BY g")
+        for row in res.rows:
+            sel = g == int(row[0])
+            u, c = np.unique(vals[sel], return_counts=True)
+            assert row[1] == [str(u[np.argmax(c)])]
+
+
+class TestIntegerTupleSketch:
+    def test_exact_below_k(self):
+        rng = np.random.default_rng(17)
+        keys = rng.integers(0, 1000, N).astype(np.int64)  # 1000 distinct < K
+        pay = rng.integers(0, 100, N).astype(np.int64)
+        schema = Schema(
+            "ts",
+            [
+                FieldSpec("k", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("p", DataType.LONG, role=FieldRole.METRIC),
+            ],
+        )
+        eng = _make_engine({"k": keys, "p": pay}, schema)
+        row = eng.query(
+            "SELECT DISTINCTCOUNTTUPLESKETCH(k, p), "
+            "SUMVALUESINTEGERSUMTUPLESKETCH(k, p) FROM ts"
+        ).rows[0]
+        assert int(row[0]) == len(np.unique(keys))
+        # below K the sketch holds every key: summary sum is exact
+        np.testing.assert_allclose(float(row[1]), float(pay.sum()))
+
+    def test_estimates_above_k(self):
+        rng = np.random.default_rng(29)
+        nd = 200_000
+        keys = rng.integers(0, nd, N * 4).astype(np.int64)
+        pay = np.ones(len(keys), dtype=np.int64)
+        schema = Schema(
+            "tb",
+            [
+                FieldSpec("k", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("p", DataType.LONG, role=FieldRole.METRIC),
+            ],
+        )
+        eng = _make_engine({"k": keys, "p": pay}, schema)
+        row = eng.query(
+            "SELECT DISTINCTCOUNTTUPLESKETCH(k, p), "
+            "SUMVALUESINTEGERSUMTUPLESKETCH(k, p) FROM tb"
+        ).rows[0]
+        true_d = len(np.unique(keys))
+        assert abs(int(row[0]) - true_d) / true_d < 0.10
+        # payload=1 everywhere: sum estimate ~ total row count
+        assert abs(float(row[1]) - len(keys)) / len(keys) < 0.10
+
+    def test_avg_value(self):
+        rng = np.random.default_rng(41)
+        keys = np.repeat(np.arange(500, dtype=np.int64), 20)
+        pay = rng.integers(1, 10, len(keys)).astype(np.int64)
+        schema = Schema(
+            "ta",
+            [
+                FieldSpec("k", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("p", DataType.LONG, role=FieldRole.METRIC),
+            ],
+        )
+        eng = _make_engine({"k": keys, "p": pay}, schema)
+        got = float(eng.query("SELECT AVGVALUEINTEGERSUMTUPLESKETCH(k, p) FROM ta").rows[0][0])
+        # exact below K: mean per-key payload sum
+        want = float(pay.sum()) / 500
+        np.testing.assert_allclose(got, want)
+
+    def test_grouped_distinct(self):
+        rng = np.random.default_rng(53)
+        g = rng.integers(0, 3, 30_000).astype(np.int32)
+        keys = rng.integers(0, 150, 30_000).astype(np.int64) + g * 1000
+        pay = np.ones(30_000, dtype=np.int64)
+        schema = Schema(
+            "tg",
+            [
+                FieldSpec("g", DataType.INT),
+                FieldSpec("k", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("p", DataType.LONG, role=FieldRole.METRIC),
+            ],
+        )
+        eng = _make_engine({"g": g, "k": keys, "p": pay}, schema)
+        res = eng.query("SELECT g, DISTINCTCOUNTTUPLESKETCH(k, p) FROM tg GROUP BY g ORDER BY g")
+        for row in res.rows:
+            true = len(np.unique(keys[g == int(row[0])]))
+            assert int(row[1]) == true  # 150 distinct < grouped K

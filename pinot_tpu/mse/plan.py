@@ -40,8 +40,13 @@ class JoinPlanError(ValueError):
 class ResolvedJoin:
     table: str  # physical dimension (build-side) table name
     join_type: str  # "inner" | "left"
-    fact_key: str  # plain fact column name (probe side)
+    fact_key: str  # plain probe-side column name (fact OR parent dim)
     dim_key: str  # plain dim column name (build side)
+    # which table owns the probe key: the fact table (star) or an
+    # earlier-joined dimension (snowflake chain — LookupJoinOperator's
+    # dim->dim analog); joins are topologically ordered so the parent's
+    # gathered rows exist before this join probes through them
+    probe_owner: str = ""
 
 
 @dataclass
@@ -57,18 +62,50 @@ class ResolvedQuery:
 def resolve(ctx: QueryContext, schemas: Dict[str, "object"]) -> ResolvedQuery:
     """schemas: table name -> object with .column_names (Schema/StackedTable)."""
     fact = ctx.table
+    if fact not in schemas:
+        raise JoinPlanError(f"table {fact!r} is not registered")
+
+    # -- self-joins: duplicate physical tables get per-ALIAS facades -------
+    # (columns renamed '{alias}${col}', storage shared — StackedTable
+    # .aliased_view; the reference disambiguates in Calcite scope binding)
+    phys = [fact] + [j.table for j in ctx.joins]
+    dup_phys = {t for t in phys if phys.count(t) > 1}
+    joins_in: List[JoinClause] = list(ctx.joins)
+    alias_prefix: Dict[str, str] = {}  # facade table name -> column prefix
+    if dup_phys:
+        rewritten: List[JoinClause] = []
+        for j in ctx.joins:
+            if j.table in dup_phys:
+                if not j.alias:
+                    raise JoinPlanError(
+                        f"self-join on {j.table!r} requires an alias for each occurrence"
+                    )
+                fname = f"{j.table}@{j.alias}"
+                if fname not in schemas:
+                    base = schemas[j.table]
+                    if not hasattr(base, "aliased_view"):
+                        raise JoinPlanError(
+                            f"self-join on {j.table!r} requires StackedTable registration"
+                        )
+                    schemas[fname] = base.aliased_view(j.alias)
+                alias_prefix[fname] = j.alias
+                rewritten.append(dataclasses.replace(j, table=fname))
+            else:
+                rewritten.append(j)
+        joins_in = rewritten
+
     alias_map: Dict[str, str] = {ctx.table_alias or fact: fact, fact: fact}
     tables: List[str] = [fact]
-    for j in ctx.joins:
+    for j in joins_in:
         if j.table not in schemas:
             raise JoinPlanError(f"joined table {j.table!r} is not registered")
         if j.table in tables:
-            raise JoinPlanError(f"table {j.table!r} joined twice (self-joins unsupported)")
+            raise JoinPlanError(
+                f"table {j.table!r} joined twice; alias each occurrence of a self-join"
+            )
         tables.append(j.table)
         alias_map[j.alias or j.table] = j.table
         alias_map.setdefault(j.table, j.table)
-    if fact not in schemas:
-        raise JoinPlanError(f"table {fact!r} is not registered")
 
     col_sets = {t: set(schemas[t].column_names) for t in tables}
 
@@ -81,6 +118,9 @@ def resolve(ctx: QueryContext, schemas: Dict[str, "object"]) -> ResolvedQuery:
             if t is None:
                 raise JoinPlanError(f"unknown table alias {q!r} in {name!r}")
             if c not in col_sets[t]:
+                pc = f"{alias_prefix[t]}${c}" if t in alias_prefix else None
+                if pc is not None and pc in col_sets[t]:
+                    return pc, t
                 raise JoinPlanError(f"table {t!r} has no column {c!r}")
             return c, t
         owners = [t for t in tables if name in col_sets[t]]
@@ -125,7 +165,7 @@ def resolve(ctx: QueryContext, schemas: Dict[str, "object"]) -> ResolvedQuery:
     extra_aggs = [rw_agg(s) for s in ctx.extra_aggregations]
 
     joins: List[ResolvedJoin] = []
-    for j in ctx.joins:
+    for j in joins_in:
         lk, lt = resolve_name(j.left_key.op)
         rk, rt = resolve_name(j.right_key.op)
         note(lk, lt)
@@ -140,12 +180,30 @@ def resolve(ctx: QueryContext, schemas: Dict[str, "object"]) -> ResolvedQuery:
                 f"JOIN ON for {j.table!r} must link it to another table "
                 f"(got {j.left_key} = {j.right_key})"
             )
-        if fk_owner != fact:
+        joins.append(ResolvedJoin(j.table, j.join_type, fact_key, dim_key, probe_owner=fk_owner))
+
+    # -- topological order: snowflake parents before their children --------
+    # (dim->dim chains probe through the PARENT's gathered rows; a chain's
+    # probe owner must itself be joined before the child runs)
+    ordered: List[ResolvedJoin] = []
+    pending = list(joins)
+    placed = {fact}
+    while pending:
+        progressed = False
+        for j in list(pending):
+            if j.probe_owner in placed:
+                ordered.append(j)
+                placed.add(j.table)
+                pending.remove(j)
+                progressed = True
+        if not progressed:
+            cyc = [(j.table, j.probe_owner) for j in pending]
             raise JoinPlanError(
-                "join keys must reference the FROM (fact) table; "
-                f"{fact_key!r} belongs to {fk_owner!r} (snowflake joins unsupported)"
+                f"join graph is not a tree rooted at {fact!r}: {cyc} "
+                "(each join's probe key must reference the fact table or an "
+                "earlier-joined dimension)"
             )
-        joins.append(ResolvedJoin(j.table, j.join_type, fact_key, dim_key))
+    joins = ordered
 
     # -- filter pushdown: split top-level AND conjuncts by owning table ----
     fact_filter: Optional[FilterNode] = None

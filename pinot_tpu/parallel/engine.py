@@ -201,6 +201,10 @@ class DistributedEngine:
                 f"num_shards={stacked.num_shards} not divisible by mesh size {self.num_devices}"
             )
         self.tables[name] = stacked
+        # drop stale self-join facades of a re-registered table (mse/plan.py
+        # resolve registers them as '{name}@{alias}')
+        for k in [k for k in self.tables if k.startswith(name + "@")]:
+            del self.tables[k]
 
     def _mse(self):
         """Join queries route to the multi-stage engine over the same mesh
@@ -701,7 +705,7 @@ class DistributedEngine:
 
         def _decoded(name: str) -> np.ndarray:
             c = stacked.column(name)
-            vals = stacked.decoded_flat(name)[docids]
+            vals = stacked.decoded_rows(name, docids)
             if c.nulls is not None and ctx.null_handling:
                 vals = np.asarray(vals, dtype=object)
                 vals[c.nulls.reshape(-1)[docids]] = None

@@ -353,15 +353,19 @@ class StackedTable:
 
         out: Dict[str, Dict[str, Any]] = {}
         for cname in cols:
-            ck = (cname, sl)
+            c = self.columns[cname]
+            # cache by BACKING-ARRAY identity, not name: self-join facades
+            # (aliased_view) rename columns but share the numpy storage —
+            # identity keys mean one HBM copy serves every alias
+            arr_id = id(c.codes if c.codes is not None else c.values)
+            ck = (arr_id, sl)
             if ck in cache:
                 out[cname] = cache[ck]
                 continue
-            c = self.columns[cname]
             entry: Dict[str, Any] = {}
             if c.codes is not None:
                 entry["codes"] = jax.device_put(_rows(c.codes), row_sharding)
-                dkey = (cname, "dict")
+                dkey = (id(c.dictionary), "dict")
                 dvals = c.dictionary.device_values()
                 if dvals is not None:
                     if dkey not in cache:
@@ -382,13 +386,44 @@ class StackedTable:
             # plus its while-loop capture copy is ~2GB of HBM for a mask the
             # kernel can derive from an iota compare.
             return out, None
-        vk = ("__valid__", sl)
+        vk = (id(self.valid), sl)
         if vk not in cache:
             cache[vk] = jax.device_put(_rows(self.valid), row_sharding)
         return out, cache[vk]
 
     def release_device(self) -> None:
-        self._device_cache = {}
+        # in-place: self-join facades (aliased_view) share this dict by
+        # reference — rebinding would leave their references pinning HBM
+        self._device_cache.clear()
+
+    # -- self-join facades ----------------------------------------------
+    def aliased_view(self, alias: str) -> "StackedTable":
+        """A facade of this table for SELF-JOINS: columns renamed to
+        '{alias}${col}' so one query can reference two instances without
+        name collisions (the reference resolves this in Calcite's scope
+        binding; here it is a table-level rename).  Storage is SHARED — the
+        facade's StackedColumn objects reference the same numpy arrays, and
+        to_device's array-identity cache keys mean one HBM copy serves
+        every alias."""
+        import dataclasses as _dc
+
+        from pinot_tpu.spi.schema import Schema as _Schema
+
+        cols = {
+            f"{alias}${n}": _dc.replace(c, name=f"{alias}${n}") for n, c in self.columns.items()
+        }
+        schema = _Schema(
+            name=f"{self.schema.name}@{alias}",
+            fields=[_dc.replace(f, name=f"{alias}${f.name}") for f in self.schema.fields],
+            primary_key_columns=[f"{alias}${c}" for c in self.schema.primary_key_columns],
+        )
+        idx = {
+            kind: {f"{alias}${n}": v for n, v in by_col.items()}
+            for kind, by_col in self.indexes.items()
+        }
+        t = StackedTable(schema, cols, self.valid, self.num_docs, indexes=idx)
+        t._device_cache = self._device_cache
+        return t
 
     # -- host decode (selection gather) ---------------------------------
     def decoded_flat(self, name: str) -> np.ndarray:
@@ -397,3 +432,12 @@ class StackedTable:
         if c.dictionary is not None:
             return c.dictionary.get_values(c.codes.reshape(-1))
         return c.values.reshape(-1)
+
+    def decoded_rows(self, name: str, rows: np.ndarray) -> np.ndarray:
+        """Decoded values for SPECIFIC flat doc ids — O(len(rows)) host work,
+        never a full-column decode (selection gathers read a LIMIT-sized
+        handful out of potentially 1B rows)."""
+        c = self.columns[name]
+        if c.dictionary is not None:
+            return c.dictionary.get_values(c.codes.reshape(-1)[rows])
+        return c.values.reshape(-1)[rows]

@@ -1,0 +1,289 @@
+"""MSE join generality (VERDICT r4 #7): join-output selection, snowflake
+chains, M:N selection — vs sqlite on the 8-device CPU mesh.
+
+Reference model: HashJoinOperator output rows + LookupJoinOperator dim->dim
+chains (pinot-query-runtime/.../runtime/operator/HashJoinOperator.java,
+LookupJoinOperator.java), golden-checked like Joins.json vs H2.
+"""
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.parallel.engine import DistributedEngine
+from pinot_tpu.parallel.stacked import StackedTable
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+N_FACT = 4000
+N_DATE = 300
+N_CITY = 24
+
+
+@pytest.fixture(scope="module")
+def world():
+    rng = np.random.default_rng(77)
+    citykeys = np.arange(N_CITY, dtype=np.int64) + 100
+    regions = np.asarray([f"region{i % 5}" for i in range(N_CITY)])
+    cities = {
+        "c_citykey": citykeys,
+        "c_region": regions,
+        "c_pop": rng.integers(1, 1000, N_CITY).astype(np.int64),
+    }
+    city_schema = Schema(
+        "city",
+        [
+            FieldSpec("c_citykey", DataType.INT),
+            FieldSpec("c_region", DataType.STRING),
+            FieldSpec("c_pop", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+
+    datekeys = (19920101 + np.arange(N_DATE) * 7).astype(np.int64)
+    dates = {
+        "d_datekey": datekeys,
+        "d_year": (1992 + (np.arange(N_DATE) // 53)).astype(np.int64),
+        # every date belongs to a city -> snowflake chain fact->dates->city
+        "d_citykey": rng.choice(citykeys, N_DATE).astype(np.int64),
+    }
+    date_schema = Schema(
+        "dates",
+        [
+            FieldSpec("d_datekey", DataType.INT),
+            FieldSpec("d_year", DataType.INT),
+            FieldSpec("d_citykey", DataType.INT),
+        ],
+    )
+
+    lineorder = {
+        # ~10% of fact keys miss the date dim (inner drops / LEFT nulls)
+        "lo_orderdate": rng.choice(
+            np.concatenate([datekeys, datekeys[:1] - 99]), N_FACT
+        ).astype(np.int64),
+        "lo_revenue": rng.integers(1, 10_000, N_FACT).astype(np.int64),
+        "lo_tag": rng.choice(["a", "b", "c"], N_FACT),
+    }
+    lo_schema = Schema(
+        "lineorder",
+        [
+            FieldSpec("lo_orderdate", DataType.INT),
+            FieldSpec("lo_revenue", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("lo_tag", DataType.STRING),
+        ],
+    )
+
+    # M:N side: 3 shipments rows per datekey for the first 64 dates
+    ship = {
+        "s_datekey": np.repeat(datekeys[:64], 3).astype(np.int64),
+        "s_mode": np.tile(np.asarray(["air", "sea", "rail"]), 64),
+    }
+    ship_schema = Schema(
+        "ship",
+        [FieldSpec("s_datekey", DataType.INT), FieldSpec("s_mode", DataType.STRING)],
+    )
+
+    eng = DistributedEngine()
+    for name, schema, data in (
+        ("lineorder", lo_schema, lineorder),
+        ("dates", date_schema, dates),
+        ("city", city_schema, cities),
+        ("ship", ship_schema, ship),
+    ):
+        eng.register_table(name, StackedTable.build(schema, dict(data), eng.num_devices))
+
+    con = sqlite3.connect(":memory:")
+    con.execute("CREATE TABLE lineorder (lo_orderdate, lo_revenue, lo_tag)")
+    con.execute("CREATE TABLE dates (d_datekey, d_year, d_citykey)")
+    con.execute("CREATE TABLE city (c_citykey, c_region, c_pop)")
+    con.execute("CREATE TABLE ship (s_datekey, s_mode)")
+    for t, cols, data in (
+        ("lineorder", ("lo_orderdate", "lo_revenue", "lo_tag"), lineorder),
+        ("dates", ("d_datekey", "d_year", "d_citykey"), dates),
+        ("city", ("c_citykey", "c_region", "c_pop"), cities),
+        ("ship", ("s_datekey", "s_mode"), ship),
+    ):
+        con.executemany(
+            f"INSERT INTO {t} VALUES ({','.join('?' * len(cols))})",
+            list(zip(*(np.asarray(data[c]).tolist() for c in cols))),
+        )
+    return eng, con
+
+
+def norm(rows):
+    out = []
+    for r in rows:
+        out.append(tuple(int(v) if isinstance(v, (np.integer,)) else v for v in r))
+    return out
+
+
+class TestJoinOutputSelection:
+    def test_inner_selection_vs_sqlite(self, world):
+        eng, con = world
+        sql = (
+            "SELECT d_year, lo_revenue FROM lineorder "
+            "JOIN dates ON lo_orderdate = d_datekey "
+            "WHERE lo_revenue > 9000 ORDER BY lo_revenue, d_year LIMIT 25"
+        )
+        got = norm(eng.query(sql).rows)
+        want = con.execute(
+            "SELECT d_year, lo_revenue FROM lineorder "
+            "JOIN dates ON lo_orderdate = d_datekey "
+            "WHERE lo_revenue > 9000 ORDER BY lo_revenue, d_year LIMIT 25"
+        ).fetchall()
+        assert got == norm(want)
+
+    def test_left_join_selection_null_dims(self, world):
+        eng, con = world
+        sql = (
+            "SELECT lo_orderdate, d_year FROM lineorder "
+            "LEFT JOIN dates ON lo_orderdate = d_datekey "
+            "ORDER BY lo_orderdate LIMIT 30"
+        )
+        got = eng.query(sql).rows
+        want = con.execute(sql).fetchall()
+        assert len(got) == len(want)
+        for (a1, a2), (b1, b2) in zip(got, want):
+            assert int(a1) == int(b1)
+            assert (a2 is None and b2 is None) or int(a2) == int(b2)
+        # unmatched keys exist and produce NULL d_year
+        assert any(r[1] is None for r in got)
+
+    def test_string_and_fact_columns(self, world):
+        eng, con = world
+        sql = (
+            "SELECT lo_tag, d_year FROM lineorder "
+            "JOIN dates ON lo_orderdate = d_datekey "
+            "WHERE d_year = 1993 ORDER BY lo_tag, d_year LIMIT 20"
+        )
+        got = [(a, int(b)) for a, b in eng.query(sql).rows]
+        want = con.execute(sql).fetchall()
+        assert got == [(a, int(b)) for a, b in want]
+
+    def test_mn_join_selection(self, world):
+        eng, con = world
+        sql = (
+            "SELECT lo_revenue, s_mode FROM lineorder "
+            "JOIN ship ON lo_orderdate = s_datekey "
+            "WHERE lo_revenue > 9500 ORDER BY lo_revenue, s_mode LIMIT 30"
+        )
+        got = [(int(a), b) for a, b in eng.query(sql).rows]
+        want = con.execute(sql).fetchall()
+        assert got == [(int(a), b) for a, b in want]
+
+
+class TestSnowflake:
+    def test_chain_groupby(self, world):
+        eng, con = world
+        sql = (
+            "SELECT c_region, SUM(lo_revenue) FROM lineorder "
+            "JOIN dates ON lo_orderdate = d_datekey "
+            "JOIN city ON d_citykey = c_citykey "
+            "GROUP BY c_region ORDER BY c_region"
+        )
+        got = [(a, int(b)) for a, b in eng.query(sql + " LIMIT 20").rows]
+        want = [(a, int(b)) for a, b in con.execute(sql).fetchall()]
+        assert got == want
+
+    def test_chain_selection(self, world):
+        eng, con = world
+        sql = (
+            "SELECT c_region, lo_revenue FROM lineorder "
+            "JOIN dates ON lo_orderdate = d_datekey "
+            "JOIN city ON d_citykey = c_citykey "
+            "WHERE lo_revenue > 9200 ORDER BY lo_revenue, c_region LIMIT 25"
+        )
+        got = [(a, int(b)) for a, b in eng.query(sql).rows]
+        want = [(a, int(b)) for a, b in con.execute(sql).fetchall()]
+        assert got == want
+
+    def test_chain_aggregation_count(self, world):
+        eng, con = world
+        sql = (
+            "SELECT COUNT(*) FROM lineorder "
+            "JOIN dates ON lo_orderdate = d_datekey "
+            "JOIN city ON d_citykey = c_citykey "
+            "WHERE c_pop > 500"
+        )
+        got = int(eng.query(sql).rows[0][0])
+        want = con.execute(sql).fetchall()[0][0]
+        assert got == want
+
+    def test_chain_left_parent_semantics(self, world):
+        eng, con = world
+        # LEFT parent: unmatched dates rows must not match the chained city
+        sql = (
+            "SELECT lo_orderdate, c_region FROM lineorder "
+            "LEFT JOIN dates ON lo_orderdate = d_datekey "
+            "LEFT JOIN city ON d_citykey = c_citykey "
+            "ORDER BY lo_orderdate LIMIT 30"
+        )
+        got = eng.query(sql).rows
+        want = con.execute(sql).fetchall()
+        assert len(got) == len(want)
+        for (a1, a2), (b1, b2) in zip(got, want):
+            assert int(a1) == int(b1)
+            assert (a2 is None) == (b2 is None)
+            if a2 is not None:
+                assert a2 == b2
+
+    def test_self_join_aggregation(self, world):
+        eng, con = world
+        # dates self-join: rows paired with the SAME-KEY row of another
+        # instance (identity pairing exercises facade resolution end-to-end)
+        sql = (
+            "SELECT COUNT(*), SUM(lo_revenue) FROM lineorder "
+            "JOIN dates d1 ON lo_orderdate = d1.d_datekey "
+        )
+        base = con.execute(
+            "SELECT COUNT(*), SUM(lo_revenue) FROM lineorder "
+            "JOIN dates d1 ON lo_orderdate = d1.d_datekey"
+        ).fetchall()[0]
+        got = eng.query(sql).rows[0]
+        assert (int(got[0]), int(got[1])) == (int(base[0]), int(base[1]))
+
+    def test_self_join_two_instances(self, world):
+        eng, con = world
+        sql = (
+            "SELECT COUNT(*) FROM lineorder "
+            "JOIN dates d1 ON lo_orderdate = d1.d_datekey "
+            "JOIN dates d2 ON d1.d_datekey = d2.d_datekey "
+            "WHERE d2.d_year = 1993"
+        )
+        got = int(eng.query(sql).rows[0][0])
+        want = con.execute(sql).fetchall()[0][0]
+        assert got == want
+
+    def test_self_join_selection(self, world):
+        eng, con = world
+        sql = (
+            "SELECT d1.d_year, d2.d_citykey, lo_revenue FROM lineorder "
+            "JOIN dates d1 ON lo_orderdate = d1.d_datekey "
+            "JOIN dates d2 ON d1.d_datekey = d2.d_datekey "
+            "WHERE lo_revenue > 9500 ORDER BY lo_revenue LIMIT 15"
+        )
+        got = [(int(a), int(b), int(c)) for a, b, c in eng.query(sql).rows]
+        want = [(int(a), int(b), int(c)) for a, b, c in con.execute(sql).fetchall()]
+        assert got == want
+
+    def test_self_join_requires_alias(self, world):
+        eng, _ = world
+        from pinot_tpu.mse.plan import JoinPlanError
+
+        with pytest.raises((JoinPlanError, ValueError)):
+            eng.query(
+                "SELECT COUNT(*) FROM lineorder "
+                "JOIN dates ON lo_orderdate = d_datekey "
+                "JOIN dates ON lo_orderdate = d_datekey"
+            )
+
+    def test_three_level_chain(self, world):
+        eng, con = world
+        # per-year revenue through the chain, grouped on the MIDDLE dim
+        sql = (
+            "SELECT d_year, SUM(lo_revenue) FROM lineorder "
+            "JOIN dates ON lo_orderdate = d_datekey "
+            "JOIN city ON d_citykey = c_citykey "
+            "WHERE c_region = 'region2' GROUP BY d_year ORDER BY d_year"
+        )
+        got = [(int(a), int(b)) for a, b in eng.query(sql + " LIMIT 20").rows]
+        want = [(int(a), int(b)) for a, b in con.execute(sql).fetchall()]
+        assert got == want

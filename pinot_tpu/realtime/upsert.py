@@ -24,13 +24,23 @@ from pinot_tpu.spi.config import TableConfig
 from pinot_tpu.spi.schema import Schema
 
 
-class _Location:
-    __slots__ = ("segment", "doc", "cmp")
+def _as_elems(v) -> Tuple:
+    """Normalize a value to MV elements: None -> (), scalar -> (v,)."""
+    if v is None:
+        return ()
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return tuple(v)
+    return (v,)
 
-    def __init__(self, segment: str, doc: int, cmp: Any):
+
+class _Location:
+    __slots__ = ("segment", "doc", "cmp", "deleted")
+
+    def __init__(self, segment: str, doc: int, cmp: Any, deleted: bool = False):
         self.segment = segment
         self.doc = doc
         self.cmp = cmp
+        self.deleted = deleted
 
 
 class PartitionUpsertMetadataManager:
@@ -56,6 +66,47 @@ class PartitionUpsertMetadataManager:
             k.lower(): v.upper()
             for k, v in (config.upsert.partial_upsert_strategies if config.upsert else {}).items()
         }
+        up = config.upsert
+        # metadataTTL: keys whose comparison value trails the watermark by
+        # more than this stop being tracked (reference
+        # ConcurrentMapPartitionUpsertMetadataManager.java:49); their rows
+        # stay valid — only dedup/replace tracking ends, as in the reference
+        self.metadata_ttl = float(getattr(up, "metadata_ttl", 0.0) or 0.0) if up else 0.0
+        self.delete_col = getattr(up, "delete_record_column", None) if up else None
+        self._cmp_watermark: Optional[float] = None
+        self._adds_since_expiry = 0
+
+    # -- metadataTTL -----------------------------------------------------
+    def _note_watermark(self, cmp: Any) -> None:
+        if self.metadata_ttl <= 0:
+            return
+        try:
+            c = float(cmp)
+        except (TypeError, ValueError):
+            return
+        if self._cmp_watermark is None or c > self._cmp_watermark:
+            self._cmp_watermark = c
+        self._adds_since_expiry += 1
+        if self._adds_since_expiry >= 1024:
+            self.expire_ttl_keys()
+
+    def expire_ttl_keys(self) -> None:
+        """Drop pk_map entries older than (watermark - metadataTTL).  Their
+        rows remain visible (valid masks untouched) except expired DELETE
+        tombstones, which simply stop rejecting older arrivals."""
+        self._adds_since_expiry = 0
+        if self.metadata_ttl <= 0 or self._cmp_watermark is None:
+            return
+        floor = self._cmp_watermark - self.metadata_ttl
+        dead = []
+        for pk, loc in self.pk_map.items():
+            try:
+                if float(loc.cmp) < floor:
+                    dead.append(pk)
+            except (TypeError, ValueError):
+                continue
+        for pk in dead:
+            del self.pk_map[pk]
 
     # -- helpers ---------------------------------------------------------
     def _pk_of(self, row: Dict[str, Any]) -> Tuple:
@@ -88,7 +139,14 @@ class PartitionUpsertMetadataManager:
         self.valid[name].append(True)
         row = msg.value
         cmp = row.get(self.cmp_col)
-        self._resolve(self._pk_of(row), _Location(name, doc_id, cmp))
+        self._note_watermark(cmp)
+        deleted = bool(self.delete_col and row.get(self.delete_col))
+        loc = _Location(name, doc_id, cmp, deleted=deleted)
+        self._resolve(self._pk_of(row), loc)
+        if deleted and self.pk_map.get(self._pk_of(row)) is loc:
+            # consistent delete: the winning tombstone hides its own row too;
+            # it stays in pk_map (rejecting older arrivals) until TTL expiry
+            self._invalidate(loc)
 
     def on_seal(self, mgr, sealed: ImmutableSegment) -> None:
         """Freeze the consuming mask into the sealed segment, remapping
@@ -116,12 +174,13 @@ class PartitionUpsertMetadataManager:
         """PARTIAL mode: merge the incoming row with the current winning row
         per column strategy (PartialUpsertHandler analog).  Strategies:
         OVERWRITE (default; incoming None keeps old), IGNORE (keep old),
-        INCREMENT (old + new).  APPEND/UNION need MV realtime (unsupported)."""
+        INCREMENT (old + new), APPEND (old MV elements + new), UNION
+        (order-preserving MV set union)."""
         row = msg.value
         if (self.config.upsert.mode or "").upper() != "PARTIAL":
             return row
         cur = self.pk_map.get(self._pk_of(row))
-        if cur is None:
+        if cur is None or cur.deleted:  # deleted PK: merge against nothing
             return row
         old = self._read_row(table_mgr, cur)
         if old is None:
@@ -138,10 +197,15 @@ class PartitionUpsertMetadataManager:
                 merged[name] = old_v
             elif strat == "INCREMENT":
                 merged[name] = (old_v or 0) + (new_v or 0)
-            elif strat in ("APPEND", "UNION"):
-                raise NotImplementedError(
-                    f"partial-upsert strategy {strat} needs multi-value realtime columns"
-                )
+            elif strat == "APPEND":
+                # MV realtime (round 5): concatenate old + incoming elements
+                merged[name] = tuple(_as_elems(old_v)) + tuple(_as_elems(new_v))
+            elif strat == "UNION":
+                out = list(_as_elems(old_v))
+                for e in _as_elems(new_v):
+                    if e not in out:
+                        out.append(e)
+                merged[name] = tuple(out)
             else:  # OVERWRITE
                 merged[name] = new_v if new_v is not None else old_v
         return merged
@@ -177,11 +241,21 @@ class PartitionUpsertMetadataManager:
             seg.valid_docs = self.valid[seg.name]
             pk_vals = [seg.column(c).decoded() for c in self.pk_cols]
             cmp_vals = seg.column(self.cmp_col).decoded()
+            del_vals = (
+                seg.column(self.delete_col).decoded()
+                if self.delete_col and self.delete_col in seg.columns
+                else None
+            )
             for doc in range(n):
                 pk = tuple(v[doc].item() if isinstance(v[doc], np.generic) else v[doc] for v in pk_vals)
                 cmp = cmp_vals[doc]
                 cmp = cmp.item() if isinstance(cmp, np.generic) else cmp
-                self._resolve(pk, _Location(seg.name, doc, cmp))
+                self._note_watermark(cmp)
+                deleted = bool(del_vals[doc]) if del_vals is not None else False
+                loc = _Location(seg.name, doc, cmp, deleted=deleted)
+                self._resolve(pk, loc)
+                if deleted and self.pk_map.get(pk) is loc:
+                    self._invalidate(loc)
 
 
 class PartitionDedupMetadataManager:

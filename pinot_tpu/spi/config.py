@@ -108,12 +108,22 @@ class UpsertConfig:
     mode: str = "NONE"  # NONE | FULL | PARTIAL
     comparison_column: Optional[str] = None
     partial_upsert_strategies: Dict[str, str] = field(default_factory=dict)
+    # metadataTTL (ConcurrentMapPartitionUpsertMetadataManager.java:49):
+    # primary keys whose comparison value falls more than this many
+    # comparison-units behind the largest seen stop being tracked; 0 = off
+    metadata_ttl: float = 0.0
+    # deleteRecordColumn: rows with a truthy value here are consistent
+    # DELETES — the PK's rows disappear from queries, and the tombstone
+    # rejects older out-of-order arrivals until TTL expiry
+    delete_record_column: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
             "mode": self.mode,
             "comparisonColumn": self.comparison_column,
             "partialUpsertStrategies": self.partial_upsert_strategies,
+            "metadataTTL": self.metadata_ttl,
+            "deleteRecordColumn": self.delete_record_column,
         }
 
     @staticmethod
@@ -122,6 +132,8 @@ class UpsertConfig:
             mode=d.get("mode", "NONE"),
             comparison_column=d.get("comparisonColumn"),
             partial_upsert_strategies=d.get("partialUpsertStrategies", {}),
+            metadata_ttl=float(d.get("metadataTTL", 0.0) or 0.0),
+            delete_record_column=d.get("deleteRecordColumn"),
         )
 
 

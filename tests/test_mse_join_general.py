@@ -170,6 +170,47 @@ class TestJoinOutputSelection:
         assert got == [(int(a), b) for a, b in want]
 
 
+class TestOrderPretrim:
+    def test_numeric_looking_strings_sort_lexicographically(self):
+        """Regression (review-caught): the ORDER BY pre-trim must rank
+        numeric-LOOKING strings like the final Python comparator
+        (lexicographic), not numerically."""
+        import sqlite3 as sq
+
+        eng = DistributedEngine()
+        n = 64
+        tags = np.asarray([str(v) for v in ([2, 9, 10, 100] * (n // 4))])
+        keys = np.arange(n, dtype=np.int64) % 8
+        eng.register_table(
+            "f",
+            StackedTable.build(
+                Schema("f", [FieldSpec("f_tag", DataType.STRING), FieldSpec("f_k", DataType.INT)]),
+                {"f_tag": tags, "f_k": keys},
+                eng.num_devices,
+            ),
+        )
+        eng.register_table(
+            "d",
+            StackedTable.build(
+                Schema("d", [FieldSpec("d_k", DataType.INT), FieldSpec("d_v", DataType.INT)]),
+                {"d_k": np.arange(8, dtype=np.int64), "d_v": np.arange(8, dtype=np.int64) * 2},
+                eng.num_devices,
+            ),
+        )
+        con = sq.connect(":memory:")
+        con.execute("CREATE TABLE f (f_tag, f_k)")
+        con.execute("CREATE TABLE d (d_k, d_v)")
+        con.executemany("INSERT INTO f VALUES (?,?)", list(zip(tags.tolist(), keys.tolist())))
+        con.executemany(
+            "INSERT INTO d VALUES (?,?)", [(int(i), int(i) * 2) for i in range(8)]
+        )
+        sql = "SELECT f_tag, d_v FROM f JOIN d ON f_k = d_k ORDER BY f_tag, d_v LIMIT 5"
+        got = [(a, int(b)) for a, b in eng.query(sql).rows]
+        want = con.execute(sql).fetchall()
+        assert got == [(a, int(b)) for a, b in want]
+        assert got[0][0] == "10"  # lexicographic, not numeric
+
+
 class TestSnowflake:
     def test_chain_groupby(self, world):
         eng, con = world
@@ -258,7 +299,8 @@ class TestSnowflake:
             "SELECT d1.d_year, d2.d_citykey, lo_revenue FROM lineorder "
             "JOIN dates d1 ON lo_orderdate = d1.d_datekey "
             "JOIN dates d2 ON d1.d_datekey = d2.d_datekey "
-            "WHERE lo_revenue > 9500 ORDER BY lo_revenue LIMIT 15"
+            "WHERE lo_revenue > 9500 "
+            "ORDER BY lo_revenue, d1.d_year, d2.d_citykey LIMIT 15"
         )
         got = [(int(a), int(b), int(c)) for a, b, c in eng.query(sql).rows]
         want = [(int(a), int(b), int(c)) for a, b, c in con.execute(sql).fetchall()]

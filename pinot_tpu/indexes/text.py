@@ -2,13 +2,17 @@
 
 Reference parity: Pinot's Lucene-backed text index
 (pinot-segment-local/.../index/text/, consumed by TEXT_MATCH through
-TextMatchFilterOperator).  Re-design: strings are dictionary-encoded, so
-tokenization runs per DICTIONARY VALUE into token -> code-bitmap tables;
-TEXT_MATCH queries evaluate host-side into one bool code table and the
-device does the usual table[codes] lookup.  Query grammar: terms (implicit
-AND), OR, NOT, "quoted phrase" (substring), trailing-* prefix wildcards —
-the commonly-used subset of Lucene query syntax (documented delta: no fuzzy
-/ boosts / fields)."""
+TextMatchFilterOperator) plus the native-FST regex dictionaries
+(pinot-segment-local/.../segment/local/utils/nativefst/).  Re-design:
+strings are dictionary-encoded, so tokenization runs per DICTIONARY VALUE
+into token -> code-bitmap tables; TEXT_MATCH queries evaluate host-side
+into one bool code table and the device does the usual table[codes]
+lookup.  Query grammar: terms (implicit AND), OR, NOT, "quoted phrase"
+(substring), trailing-* prefixes, /regex/ terms (RE over the token
+dictionary — the FST-regex analog, O(tokens) not O(rows)), mid-token
+wildcards (te*m, t?m), and term~N fuzzy matching (banded Levenshtein over
+the token dictionary; ~ defaults to distance 2 like Lucene).  Documented
+delta: no boosts / fields."""
 from __future__ import annotations
 
 import re
@@ -58,7 +62,7 @@ class TextIndex:
             result |= g
         return result
 
-    def _eval_term(self, kind: str, term: str, card: int) -> np.ndarray:
+    def _eval_term(self, kind: str, term, card: int) -> np.ndarray:
         if kind == "phrase":
             needle = term.lower()
             return np.array([needle in str(v).lower() for v in self.values], dtype=bool)
@@ -66,6 +70,22 @@ class TextIndex:
             out = np.zeros(card, dtype=bool)
             for tok, tbl in self.tokens.items():
                 if tok.startswith(term):
+                    out |= tbl
+            return out
+        if kind == "regex":
+            # regex over the TOKEN DICTIONARY, never the rows — the same
+            # O(distinct tokens) trade as the reference's FST regex
+            rx = re.compile(term)
+            out = np.zeros(card, dtype=bool)
+            for tok, tbl in self.tokens.items():
+                if rx.fullmatch(tok):
+                    out |= tbl
+            return out
+        if kind == "fuzzy":
+            base, dist = term
+            out = np.zeros(card, dtype=bool)
+            for tok, tbl in self.tokens.items():
+                if abs(len(tok) - len(base)) <= dist and _edit_within(base, tok, dist):
                     out |= tbl
             return out
         tbl = self.tokens.get(term)
@@ -92,9 +112,29 @@ class TextIndex:
                 groups[-1].append((pending_not, "phrase", m.group("phrase")[1:-1]))
                 pending_not = False
             else:
-                term = m.group("term").lower()
-                kind = "prefix" if term.endswith("*") else "term"
-                groups[-1].append((pending_not, kind, term.rstrip("*")))
+                raw = m.group("term")
+                if len(raw) >= 2 and raw.startswith("/") and raw.endswith("/"):
+                    # /regex/ term (Lucene RegexpQuery syntax); tokens are
+                    # lowercase, so the pattern compiles case-insensitively
+                    groups[-1].append((pending_not, "regex", f"(?i:{raw[1:-1]})"))
+                    pending_not = False
+                    continue
+                term = raw.lower()
+                fz = re.fullmatch(r"(.+?)~(\d*)", term)
+                if fz:
+                    dist = int(fz.group(2)) if fz.group(2) else 2
+                    groups[-1].append((pending_not, "fuzzy", (fz.group(1), dist)))
+                elif term.endswith("*") and "*" not in term[:-1] and "?" not in term:
+                    groups[-1].append((pending_not, "prefix", term.rstrip("*")))
+                elif "*" in term or "?" in term:
+                    # mid-token wildcards -> anchored regex over tokens
+                    pat = "".join(
+                        ".*" if ch == "*" else "." if ch == "?" else re.escape(ch)
+                        for ch in term
+                    )
+                    groups[-1].append((pending_not, "regex", pat))
+                else:
+                    groups[-1].append((pending_not, "term", term))
                 pending_not = False
         return [g for g in groups if g]
 
@@ -121,3 +161,27 @@ class TextIndex:
             tokens[t] = tbl
         vals = dict_values if dict_values is not None else np.array([""] * card, dtype=object)
         return TextIndex(tokens, vals)
+
+
+def _edit_within(a: str, b: str, k: int) -> bool:
+    """Banded Levenshtein: True iff edit distance(a, b) <= k (the fuzzy-term
+    predicate; band width 2k+1 keeps it O(len * k))."""
+    la, lb = len(a), len(b)
+    if abs(la - lb) > k:
+        return False
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        lo = max(1, i - k)
+        hi = min(lb, i + k)
+        if lo > 1:
+            cur[lo - 1] = k + 1
+        for j in range(lo, hi + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+        if hi < lb:
+            cur[hi + 1 :] = [k + 1] * (lb - hi)
+        prev = cur
+        if min(prev[lo - 1 : hi + 1]) > k:
+            return False
+    return prev[lb] <= k

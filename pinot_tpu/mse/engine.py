@@ -85,7 +85,10 @@ def _order_pretrim(order_by, ord_cols, want: int, is_str: List[bool]):
         k = np.empty(n, dtype=np.float64)
         try:
             if s:
-                _, inv = np.unique(body.astype(str), return_inverse=True)
+                # unique over the RAW objects: python `<` ordering (str AND
+                # bytes alike) must match the final comparator —
+                # astype(str) would rank bytes by their repr (review-caught)
+                _, inv = np.unique(body, return_inverse=True)
                 num = inv.astype(np.float64)
             else:
                 num = body.astype(np.float64)

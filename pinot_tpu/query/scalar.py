@@ -23,6 +23,7 @@ Re-design, two executions domains:
 """
 from __future__ import annotations
 
+import functools
 import math
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -208,20 +209,20 @@ def _truncator(v, *args):
 
 
 DEVICE_FNS: Dict[str, Callable] = {
-    "datetrunc": lambda v, unit, *rest: date_trunc(str(unit), _in_ms(v, rest)),
-    "year": lambda v, *a: _extract("year", _in_ms(v, a)),
-    "quarter": lambda v, *a: _extract("quarter", _in_ms(v, a)),
-    "month": lambda v, *a: _extract("month", _in_ms(v, a)),
-    "week": lambda v, *a: _extract("week", _in_ms(v, a)),
-    "weekofyear": lambda v, *a: _extract("week", _in_ms(v, a)),
-    "day": lambda v, *a: _extract("day", _in_ms(v, a)),
-    "dayofmonth": lambda v, *a: _extract("day", _in_ms(v, a)),
-    "dayofweek": lambda v, *a: _extract("dayofweek", _in_ms(v, a)),
-    "dayofyear": lambda v, *a: _extract("dayofyear", _in_ms(v, a)),
-    "hour": lambda v, *a: _extract("hour", _in_ms(v, a)),
-    "minute": lambda v, *a: _extract("minute", _in_ms(v, a)),
-    "second": lambda v, *a: _extract("second", _in_ms(v, a)),
-    "millisecond": lambda v, *a: _extract("millisecond", _in_ms(v, a)),
+    "datetrunc": lambda v, unit, *rest: _date_trunc_args(str(unit), v, rest),
+    "year": lambda v, *a: _extract("year", _dt_ms(v, a)),
+    "quarter": lambda v, *a: _extract("quarter", _dt_ms(v, a)),
+    "month": lambda v, *a: _extract("month", _dt_ms(v, a)),
+    "week": lambda v, *a: _extract("week", _dt_ms(v, a)),
+    "weekofyear": lambda v, *a: _extract("week", _dt_ms(v, a)),
+    "day": lambda v, *a: _extract("day", _dt_ms(v, a)),
+    "dayofmonth": lambda v, *a: _extract("day", _dt_ms(v, a)),
+    "dayofweek": lambda v, *a: _extract("dayofweek", _dt_ms(v, a)),
+    "dayofyear": lambda v, *a: _extract("dayofyear", _dt_ms(v, a)),
+    "hour": lambda v, *a: _extract("hour", _dt_ms(v, a)),
+    "minute": lambda v, *a: _extract("minute", _dt_ms(v, a)),
+    "second": lambda v, *a: _extract("second", _dt_ms(v, a)),
+    "millisecond": lambda v, *a: _extract("millisecond", _dt_ms(v, a)),
     "timeconvert": lambda v, fu, tu: time_convert(v, str(fu), str(tu)),
     "datetimeconvert": lambda v, i, o, g: datetime_convert(v, str(i), str(o), str(g)),
     "round": _rounder,
@@ -293,6 +294,114 @@ def _in_ms(v, unit_args) -> jnp.ndarray:
     if unit_args:
         v = v.astype(jnp.int64) * TIME_UNIT_MS[str(unit_args[0]).upper()]
     return v
+
+
+# ---------------------------------------------------------------------------
+# Timezones (DateTimeFunctions.java tz-suffixed variants — VERDICT r4
+# missing #7).  No per-row host calls: each zone compiles ONCE into a
+# (transition instants, offset) table via stdlib zoneinfo probing, and the
+# device resolves per-row offsets with a searchsorted over the baked
+# constants (~couple hundred entries for 1970-2080) — DST arithmetic as two
+# vector ops instead of a Joda chronology.
+# ---------------------------------------------------------------------------
+_TZ_YEARS = (1970, 2080)
+
+
+@functools.lru_cache(maxsize=None)
+def _tz_table(tz_name: str):
+    """(transition_ms int64[n], offset_ms int64[n]): offset_ms[i] is the
+    zone's UTC offset from transition_ms[i] (until the next entry).  Built
+    by ~monthly probing with bisection to 1-minute precision (zoneinfo
+    exposes no transition list; real transitions are >1 month apart)."""
+    import datetime as _dt
+
+    try:
+        from zoneinfo import ZoneInfo
+
+        tz = ZoneInfo(tz_name)
+    except Exception as e:  # unknown zone: match Pinot's error surface
+        raise ValueError(f"unknown time zone {tz_name!r}") from e
+
+    def off(ms_v: int) -> int:
+        # fromtimestamp(tz=tz) localizes the INSTANT; utcoffset() then reads
+        # the zone's offset at it (ZoneInfo.utcoffset(naive_utc) would treat
+        # the UTC wall reading as local time — hours off near transitions)
+        return int(_dt.datetime.fromtimestamp(ms_v / 1000, tz=tz).utcoffset().total_seconds() * 1000)
+
+    y0, y1 = _TZ_YEARS
+    start = int(_dt.datetime(y0, 1, 1, tzinfo=_dt.timezone.utc).timestamp() * 1000)
+    end = int(_dt.datetime(y1, 1, 1, tzinfo=_dt.timezone.utc).timestamp() * 1000)
+    step = 28 * MS_DAY
+    trans = [np.iinfo(np.int64).min]
+    offs = [off(start)]
+    t = start
+    while t < end:
+        nt = min(t + step, end)
+        o = off(nt)
+        if o != offs[-1]:
+            lo, hi = t, nt
+            while hi - lo > 60_000:
+                mid = (lo + hi) // 2
+                if off(mid) == offs[-1]:
+                    lo = mid
+                else:
+                    hi = mid
+            trans.append(hi)
+            offs.append(o)
+        t = nt
+    return np.asarray(trans, np.int64), np.asarray(offs, np.int64)
+
+
+def _tz_offset_ms(ms, tz_name: str):
+    trans, offs = _tz_table(tz_name)
+    idx = jnp.clip(
+        jnp.searchsorted(jnp.asarray(trans), ms, side="right") - 1, 0, len(offs) - 1
+    )
+    return jnp.asarray(offs)[idx]
+
+
+def _split_dt_args(args):
+    """Pinot's (col[, inputTimeUnit][, tzId][, outputTimeUnit]) literal tail
+    -> (unit list in order, tz or None).  Literals naming a TimeUnit are
+    units (first = input, second = output — the 5-arg dateTrunc form);
+    anything else is the zone id."""
+    unit_args, tz = [], None
+    for a in args:
+        s = str(a)
+        if s.upper() in TIME_UNIT_MS:
+            unit_args.append(s)
+        else:
+            tz = s
+    if tz is not None and tz.upper() in ("UTC", "GMT", "Z"):
+        tz = None
+    return unit_args, tz
+
+
+def _dt_ms(v, args):
+    """Input millis shifted into the arg-designated zone's local time."""
+    unit_args, tz = _split_dt_args(args)
+    ms = _in_ms(v, unit_args[:1]).astype(jnp.int64)
+    if tz is not None:
+        ms = ms + _tz_offset_ms(ms, tz)
+    return ms
+
+
+def _date_trunc_args(unit: str, v, rest):
+    """DATETRUNC(unit, col[, inputTimeUnit][, tz][, outputTimeUnit]):
+    truncate in local wall time; result in outputTimeUnit (default millis,
+    the reference's 5-arg form).  The instant's own offset maps the bucket
+    start back — exact except for buckets that straddle a DST shift (the
+    reference's chronology handles those; documented delta)."""
+    unit_args, tz = _split_dt_args(rest)
+    ms = _in_ms(v, unit_args[:1]).astype(jnp.int64)
+    if tz is None:
+        out = date_trunc(unit, ms)
+    else:
+        o = _tz_offset_ms(ms, tz)
+        out = date_trunc(unit, ms + o) - o
+    if len(unit_args) > 1:
+        out = out // TIME_UNIT_MS[str(unit_args[1]).upper()]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -413,11 +522,17 @@ def _java_fmt_to_strptime(fmt: str) -> str:
     return _re.sub(r"'([^']*)'", r"\1", out)
 
 
-def _from_datetime(values: np.ndarray, fmt: str) -> np.ndarray:
-    """FROMDATETIME(strCol, 'yyyy-MM-dd ...') -> epoch millis (UTC).
-    Runs over the DICTIONARY (cardinality work) like all string functions."""
+def _from_datetime(values: np.ndarray, fmt: str, tz_name: Optional[str] = None) -> np.ndarray:
+    """FROMDATETIME(strCol, 'yyyy-MM-dd ...'[, tzId]) -> epoch millis; the
+    string is interpreted as wall time in tzId (default UTC).  Runs over the
+    DICTIONARY (cardinality work) like all string functions."""
     import datetime as _dt
 
+    tzinfo = _dt.timezone.utc
+    if tz_name is not None and str(tz_name).upper() not in ("UTC", "GMT", "Z"):
+        from zoneinfo import ZoneInfo
+
+        tzinfo = ZoneInfo(str(tz_name))
     py_fmt = _java_fmt_to_strptime(str(fmt))
     has_millis = "%f" in py_fmt
     out = np.empty(len(values), dtype=np.int64)
@@ -429,7 +544,7 @@ def _from_datetime(values: np.ndarray, fmt: str) -> np.ndarray:
             if base and len(frac) == 3:
                 s = f"{base}.{frac}000"
         try:
-            d = _dt.datetime.strptime(s, py_fmt).replace(tzinfo=_dt.timezone.utc)
+            d = _dt.datetime.strptime(s, py_fmt).replace(tzinfo=tzinfo)
             out[i] = int(d.timestamp() * 1000)
         except ValueError:
             out[i] = np.iinfo(np.int64).min  # unparseable -> placeholder
@@ -439,15 +554,20 @@ def _from_datetime(values: np.ndarray, fmt: str) -> np.ndarray:
 DICT_FNS["fromdatetime"] = _from_datetime
 
 
-def to_datetime(ms, fmt: str):
-    """TODATETIME(epochMillis, fmt) -> formatted string (host/selection path;
-    strings never materialize on device)."""
+def to_datetime(ms, fmt: str, tz_name: Optional[str] = None):
+    """TODATETIME(epochMillis, fmt[, tzId]) -> formatted string
+    (host/selection path; strings never materialize on device)."""
     import datetime as _dt
 
+    tzinfo = _dt.timezone.utc
+    if tz_name is not None and str(tz_name).upper() not in ("UTC", "GMT", "Z"):
+        from zoneinfo import ZoneInfo
+
+        tzinfo = ZoneInfo(str(tz_name))
     py_fmt = _java_fmt_to_strptime(str(fmt))
     out = np.empty(len(ms), dtype=object)
     for i, v in enumerate(np.asarray(ms)):
-        d = _dt.datetime.fromtimestamp(int(v) / 1000, tz=_dt.timezone.utc)
+        d = _dt.datetime.fromtimestamp(int(v) / 1000, tz=tzinfo)
         # SSS = milliseconds: substitute into the FORMAT (a post-hoc string
         # replace corrupted outputs whose digits matched — review-caught)
         fmt_i = py_fmt.replace("%f", f"{d.microsecond // 1000:03d}")
@@ -570,16 +690,23 @@ def expr_int_range(expr, segment) -> Optional[Tuple[int, int]]:
     if op == "datetrunc" and len(args) == 1 and args[0] is not None and lits:
         lo, hi = args[0]
         unit = str(lits[0])
-        in_ms = TIME_UNIT_MS[str(lits[1]).upper()] if len(lits) > 1 else 1
+        unit_args, tz = _split_dt_args(lits[1:])
+        in_ms = TIME_UNIT_MS[str(unit_args[0]).upper()] if unit_args else 1
         f = lambda x: int(date_trunc(unit, jnp.asarray([x * in_ms], dtype=jnp.int64))[0])
+        if tz is not None:
+            # local truncation shifts results by at most a day either way;
+            # widen (over-approximation is safe for range sizing)
+            return (f(lo) - MS_DAY, f(hi) + MS_DAY)
         return (f(lo), f(hi))
     if op in ("year", "quarter", "month", "week", "weekofyear", "day", "dayofmonth", "hour", "minute", "second") and len(args) == 1 and args[0] is not None:
         lo, hi = args[0]
-        in_ms = TIME_UNIT_MS[str(lits[0]).upper()] if lits else 1
+        unit_args, tz = _split_dt_args(lits)
+        in_ms = TIME_UNIT_MS[str(unit_args[0]).upper()] if unit_args else 1
         # YEAR is monotone in the epoch; cyclic parts use the full part range
         if op == "year":
-            glo = int(_extract("year", jnp.asarray([lo * in_ms], dtype=jnp.int64))[0])
-            ghi = int(_extract("year", jnp.asarray([hi * in_ms], dtype=jnp.int64))[0])
+            pad = MS_DAY if tz is not None else 0  # zone shift < a day
+            glo = int(_extract("year", jnp.asarray([lo * in_ms - pad], dtype=jnp.int64))[0])
+            ghi = int(_extract("year", jnp.asarray([hi * in_ms + pad], dtype=jnp.int64))[0])
             return (glo, ghi)
         return {
             "quarter": (1, 4),

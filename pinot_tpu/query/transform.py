@@ -339,9 +339,10 @@ def eval_expr_host(expr: Expr, segment: ImmutableSegment, docids: np.ndarray) ->
         if len(traced) == 1:
             v = eval_expr_host(traced[0], segment, docids)
             return np.asarray(scalar.DEVICE_FNS[op](jnp.asarray(v), *lits))
-    if op == "todatetime" and len(expr.args) == 2 and expr.args[1].is_literal:
+    if op == "todatetime" and len(expr.args) in (2, 3) and expr.args[1].is_literal:
         v = eval_expr_host(expr.args[0], segment, docids)
-        return scalar.to_datetime(v, expr.args[1].value)
+        tz = expr.args[2].value if len(expr.args) == 3 and expr.args[2].is_literal else None
+        return scalar.to_datetime(v, expr.args[1].value, tz)
     if op == "cast" and len(expr.args) == 2 and expr.args[1].is_literal:
         v = eval_expr_host(expr.args[0], segment, docids)
         target = str(expr.args[1].value).upper()

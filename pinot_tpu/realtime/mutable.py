@@ -88,7 +88,8 @@ class MutableSegment:
                 self._mv.add(f.name)
             self._buffers[f.name] = []
             self._null_counts[f.name] = 0
-            if f.data_type.is_string_like:
+            if f.data_type.is_string_like and f.name not in self._mv:
+                # MV strings buffer decoded tuples directly (no append dict)
                 self._dicts[f.name] = AppendDictionary()
         self._num_docs = 0
         self._snapshot: Optional[ImmutableSegment] = None
@@ -111,8 +112,9 @@ class MutableSegment:
             v = row.get(f.name)
             buf = self._buffers[f.name]
             if f.name in self._mv:
-                elems = () if v is None else tuple(v) if isinstance(v, (list, tuple, np.ndarray)) else (v,)
-                buf.append(tuple(_coerce(f.data_type, e) for e in elems))
+                from pinot_tpu.realtime.upsert import _as_elems
+
+                buf.append(tuple(_coerce(f.data_type, e) for e in _as_elems(v)))
                 continue
             if v is None or (isinstance(v, float) and np.isnan(v)):
                 if not f.nullable:

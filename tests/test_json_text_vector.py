@@ -149,6 +149,49 @@ class TestTextIndex:
         expected = sum(1 for b in data["body"] if any(t.startswith("jump") for t in b.split()))
         assert res.rows[0][0] == expected
 
+    def test_regex_term(self, eng, data):
+        """/regex/ terms match over the token dictionary (FST-regex analog)."""
+        got = eng.query("SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, '/qu.ck/')").rows[0][0]
+        want = sum(1 for b in data["body"] if "quick" in b.split())
+        assert int(got) == want
+        got2 = eng.query(
+            "SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, '/(fox|dog)/')"
+        ).rows[0][0]
+        want2 = sum(1 for b in data["body"] if {"fox", "dog"} & set(b.split()))
+        assert int(got2) == want2
+
+    def test_mid_token_wildcard(self, eng, data):
+        got = eng.query("SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, 'an*tics')").rows[0][0]
+        want = sum(1 for b in data["body"] if "analytics" in b.split())
+        assert int(got) == want
+        got2 = eng.query("SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, 'f?x')").rows[0][0]
+        want2 = sum(1 for b in data["body"] if "fox" in b.split())
+        assert int(got2) == want2
+
+    def test_fuzzy_term(self, eng, data):
+        # 'quickk'~1 matches 'quick' (one deletion)
+        got = eng.query("SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, 'quickk~1')").rows[0][0]
+        want = sum(1 for b in data["body"] if "quick" in b.split())
+        assert int(got) == want
+        # default ~ distance is 2: 'analytcs' (1 deletion) matches analytics
+        got2 = eng.query("SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, 'analytcs~')").rows[0][0]
+        want2 = sum(1 for b in data["body"] if "analytics" in b.split())
+        assert int(got2) == want2
+        # distance 1 does NOT match a 2-edit-away token ('serch' vs 'search'
+        # is 1 deletion; use 'sarch'~0 -> no match)
+        got3 = eng.query("SELECT COUNT(*) FROM docs WHERE TEXT_MATCH(body, 'sarch~0')").rows[0][0]
+        assert int(got3) == 0
+
+    def test_edit_distance_helper(self):
+        from pinot_tpu.indexes.text import _edit_within
+
+        assert _edit_within("kitten", "sitting", 3)
+        assert not _edit_within("kitten", "sitting", 2)
+        assert _edit_within("abc", "abc", 0)
+        assert not _edit_within("abc", "abd", 0)
+        assert _edit_within("abc", "abd", 1)
+        assert not _edit_within("a", "abcd", 2)
+
     def test_lazy_text_index_without_config(self, data):
         """TEXT_MATCH works without a configured index (lazy dictionary
         tokenization), it just isn't counted as an index use."""

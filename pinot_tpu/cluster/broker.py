@@ -25,11 +25,77 @@ from pinot_tpu.query.result import ExecutionStats, ResultTable
 from pinot_tpu.utils.hashing import partition_of
 
 
+class QuotaExceededError(RuntimeError):
+    """Per-table QPS quota hit (the reference returns 429 with
+    BrokerErrorCode QUERY_QUOTA_EXCEEDED)."""
+
+
+class QueryQuotaManager:
+    """Per-table query rate limiting (HelixExternalViewBasedQueryQuotaManager,
+    pinot-broker/.../broker/queryquota/).  Token bucket per table against
+    TableConfig quota.maxQueriesPerSecond — refill rate q, burst capacity
+    max(1, q), so fractional quotas (q=0.5 -> one query per 2s) throttle
+    correctly.  The reference divides the table quota across online
+    brokers — single broker here, so the full quota applies (documented)."""
+
+    def __init__(self) -> None:
+        # table -> [tokens, last_refill_monotonic]
+        self._buckets: Dict[str, List[float]] = {}
+
+    def check(self, table: str, max_qps: float, now: Optional[float] = None) -> None:
+        if max_qps <= 0:
+            return
+        t = time.monotonic() if now is None else now
+        cap = max(1.0, float(max_qps))
+        b = self._buckets.get(table)
+        if b is None:
+            b = self._buckets[table] = [cap, t]
+        tokens = min(cap, b[0] + max_qps * (t - b[1]))
+        b[1] = t
+        if tokens < 1.0:
+            b[0] = tokens
+            raise QuotaExceededError(
+                f"table {table!r} exceeded maxQueriesPerSecond={max_qps:g}"
+            )
+        b[0] = tokens - 1.0
+
+
+class AdaptiveServerStats:
+    """Latency-biased replica scoring (pinot-broker/.../routing/
+    adaptiveserverselector/ — NumInFlightReqSelector + LatencySelector
+    hybrid): servers rank by EWMA latency scaled by (1 + in-flight), so
+    slow or busy replicas shed load to their peers."""
+
+    ALPHA = 0.3
+
+    def __init__(self) -> None:
+        self.ewma_ms: Dict[str, float] = {}
+        self.in_flight: Dict[str, int] = {}
+
+    def begin(self, server: str) -> None:
+        self.in_flight[server] = self.in_flight.get(server, 0) + 1
+
+    def end(self, server: str, latency_ms: float) -> None:
+        self.in_flight[server] = max(0, self.in_flight.get(server, 1) - 1)
+        prev = self.ewma_ms.get(server)
+        self.ewma_ms[server] = (
+            latency_ms if prev is None else prev + self.ALPHA * (latency_ms - prev)
+        )
+
+    def score(self, server: str) -> float:
+        # unseen servers score best (explore), matching the reference's
+        # default-to-fallback behavior for servers without stats
+        lat = self.ewma_ms.get(server, 0.0)
+        return lat * (1.0 + self.in_flight.get(server, 0))
+
+
 class Broker:
     def __init__(self, coordinator: Coordinator, selector: str = "balanced"):
         self.coordinator = coordinator
-        self.selector = selector  # "balanced" | "replicagroup"
+        self.selector = selector  # "balanced" | "replicagroup" | "adaptive"
         self._rr = 0  # round-robin cursor
+        self.quota = QueryQuotaManager()
+        self.server_stats = AdaptiveServerStats()
 
     # -- routing table (built per query from the external view) -----------
     def _route(self, table: str, seg_names: List[str]) -> Dict[str, List[str]]:
@@ -62,7 +128,15 @@ class Broker:
             candidates = sorted(view.get(seg, ()))
             if not candidates:
                 raise RuntimeError(f"segment {table}/{seg} has no live replica")
-            srv = candidates[(self._rr + i) % len(candidates)]
+            if self.selector == "adaptive":
+                # latency-biased: best (lowest) score wins; round-robin
+                # breaks exact ties so cold starts still spread
+                srv = min(
+                    candidates,
+                    key=lambda s, i=i: (self.server_stats.score(s), (self._rr + i + candidates.index(s)) % len(candidates)),
+                )
+            else:
+                srv = candidates[(self._rr + i) % len(candidates)]
             assign.setdefault(srv, []).append(seg)
         return assign
 
@@ -122,6 +196,8 @@ class Broker:
         table = ctx.table
         if table not in self.coordinator.tables:
             raise KeyError(f"table {table!r} not found")
+        # per-table QPS quota (checked before any work is scheduled)
+        self.quota.check(table, self.coordinator.tables[table].config.max_queries_per_second)
         self._inject_global_ranges(ctx, table)
         # hybrid tables (offline segments + a realtime manager under ONE
         # name): a TIME BOUNDARY splits the parts — offline answers
@@ -151,7 +227,12 @@ class Broker:
             for server_name, segs in assign.items():
                 deadline.check(f"query on {table}")
                 server = self.coordinator.servers[server_name]
-                res, sstats = server.execute(offline_ctx, segs, table_schema=meta.schema)
+                self.server_stats.begin(server_name)
+                st0 = time.perf_counter()
+                try:
+                    res, sstats = server.execute(offline_ctx, segs, table_schema=meta.schema)
+                finally:
+                    self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
                 results.extend(res)
                 stats.num_segments_queried += sstats.num_segments_queried
                 stats.num_segments_processed += sstats.num_segments_processed

@@ -53,6 +53,8 @@ class MinionTaskManager:
             "MergeRollupTask": self.merge_rollup,
             "PurgeTask": self.purge,
             "RealtimeToOfflineSegmentsTask": self.realtime_to_offline,
+            "UpsertCompactionTask": self.upsert_compact,
+            "RefreshSegmentTask": self.refresh,
         }
 
     def run(self, task_type: str, table: str, **kw) -> Dict[str, Any]:
@@ -219,6 +221,84 @@ class MinionTaskManager:
             realtime_manager.sealed[p] = remaining
         meta.segment_meta["__rto_watermark__"] = {"value": window_end_ms}
         return {"moved": moved, "watermarkMs": window_end_ms, "offlineTable": offline_table}
+
+    # -- UpsertCompactionTask --------------------------------------------
+    def upsert_compact(
+        self,
+        table: str,
+        realtime_manager=None,
+        invalid_threshold: float = 0.1,
+    ) -> Dict[str, Any]:
+        """Rewrite sealed realtime segments whose upsert validDocIds mask
+        carries >= invalid_threshold masked-out rows, dropping them
+        physically (UpsertCompactionTaskExecutor analog — the reference
+        reads the server's validDocIds snapshot the same way).
+
+        Compaction preserves surviving-row order (no re-sort), so the
+        partition upsert manager's pk_map locations remap through the
+        kept-row prefix; the fresh mask is all-true.  The swap is
+        in-memory — on restart the manager replays raw rows and rebuilds
+        equivalent masks (bootstrap path), so durability is unaffected."""
+        import dataclasses
+
+        rt = realtime_manager or self.coordinator.realtime.get(table)
+        if rt is None or getattr(rt, "upsert", None) is None:
+            raise ValueError(f"UpsertCompactionTask needs an upsert-enabled realtime table ({table!r})")
+        um = rt.upsert
+        cfg = dataclasses.replace(
+            rt.config, indexing=dataclasses.replace(rt.config.indexing, sorted_column=None)
+        )
+        report = {"compacted": [], "rowsDropped": 0}
+        remaps: Dict[str, Dict[int, int]] = {}  # segment -> old doc -> new doc
+        for p, sealed_list in rt.sealed.items():
+            out_list = []
+            for seg in sealed_list:
+                mask = seg.valid_docs
+                inv = int((~np.asarray(mask, dtype=bool)).sum()) if mask is not None else 0
+                if inv == 0 or inv / max(1, seg.num_docs) < invalid_threshold:
+                    out_list.append(seg)
+                    continue
+                keep = np.nonzero(np.asarray(mask, dtype=bool))[0]
+                data = _concat_columns(rt.schema, [seg])
+                data = {k: v[keep] for k, v in data.items()}
+                new_seg = build_segment(rt.schema, data, seg.name, cfg)
+                remaps[seg.name] = {int(d): j for j, d in enumerate(keep)}
+                fresh = np.ones(len(keep), dtype=bool)
+                um.valid[seg.name] = fresh
+                new_seg.valid_docs = fresh
+                out_list.append(new_seg)
+                report["compacted"].append(seg.name)
+                report["rowsDropped"] += inv
+            rt.sealed[p] = out_list
+        if remaps:  # one pk_map pass for all compacted segments
+            for loc in um.pk_map.values():
+                m = remaps.get(loc.segment)
+                if m is not None and loc.doc in m:
+                    loc.doc = m[loc.doc]
+        return report
+
+    # -- RefreshSegmentTask ----------------------------------------------
+    def refresh(self, table: str, segment_name: Optional[str] = None) -> Dict[str, Any]:
+        """Rebuild offline segments with the table's CURRENT config/schema —
+        picks up newly configured indexes, sort columns, dictionary changes
+        (RefreshSegmentTaskExecutor analog)."""
+        meta = self.coordinator.tables[table]
+        names = [segment_name] if segment_name else list(meta.ideal)
+        refreshed = []
+        for name in names:
+            segs = self._segment_objects(table, [name])
+            if not segs:
+                continue
+            data = _concat_columns(meta.schema, segs)
+            new_seg = build_segment(meta.schema, data, name, meta.config)
+            # drop the old assignment, then re-add under the same name
+            for s in meta.ideal.pop(name, set()):
+                if s in self.coordinator.servers:
+                    self.coordinator.servers[s].drop_segment(table, name)
+            meta.segment_meta.pop(name, None)
+            self.coordinator.add_segment(table, new_seg)
+            refreshed.append(name)
+        return {"refreshed": refreshed}
 
 
 def _offline_config(cfg, name: str):

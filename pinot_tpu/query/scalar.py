@@ -692,12 +692,16 @@ def expr_int_range(expr, segment) -> Optional[Tuple[int, int]]:
         unit = str(lits[0])
         unit_args, tz = _split_dt_args(lits[1:])
         in_ms = TIME_UNIT_MS[str(unit_args[0]).upper()] if unit_args else 1
+        # the 5-arg outputTimeUnit division MUST mirror _date_trunc_args —
+        # a millis-ranged GroupDim against seconds-valued rows decodes
+        # garbage group keys (review-caught)
+        out_div = TIME_UNIT_MS[str(unit_args[1]).upper()] if len(unit_args) > 1 else 1
         f = lambda x: int(date_trunc(unit, jnp.asarray([x * in_ms], dtype=jnp.int64))[0])
         if tz is not None:
             # local truncation shifts results by at most a day either way;
             # widen (over-approximation is safe for range sizing)
-            return (f(lo) - MS_DAY, f(hi) + MS_DAY)
-        return (f(lo), f(hi))
+            return ((f(lo) - MS_DAY) // out_div, (f(hi) + MS_DAY) // out_div)
+        return (f(lo) // out_div, f(hi) // out_div)
     if op in ("year", "quarter", "month", "week", "weekofyear", "day", "dayofmonth", "hour", "minute", "second") and len(args) == 1 and args[0] is not None:
         lo, hi = args[0]
         unit_args, tz = _split_dt_args(lits)

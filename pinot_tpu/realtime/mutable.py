@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from pinot_tpu.realtime.upsert import _as_elems
 from pinot_tpu.segment.builder import build_segment
 from pinot_tpu.segment.segment import ImmutableSegment
 from pinot_tpu.spi.config import TableConfig
@@ -112,8 +113,6 @@ class MutableSegment:
             v = row.get(f.name)
             buf = self._buffers[f.name]
             if f.name in self._mv:
-                from pinot_tpu.realtime.upsert import _as_elems
-
                 buf.append(tuple(_coerce(f.data_type, e) for e in _as_elems(v)))
                 continue
             if v is None or (isinstance(v, float) and np.isnan(v)):

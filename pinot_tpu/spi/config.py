@@ -202,6 +202,10 @@ class TableConfig:
     partition_column: Optional[str] = None
     num_partitions: int = 0
     tenant: str = "default"
+    # Per-table query rate limit (QuotaConfig.maxQueriesPerSecond,
+    # enforced at the broker: HelixExternalViewBasedQueryQuotaManager
+    # analog); 0 = unlimited
+    max_queries_per_second: float = 0.0
 
     @property
     def table_name_with_type(self) -> str:
@@ -224,6 +228,8 @@ class TableConfig:
         if self.partition_column:
             d["partitionColumn"] = self.partition_column
             d["numPartitions"] = self.num_partitions
+        if self.max_queries_per_second:
+            d["quota"] = {"maxQueriesPerSecond": self.max_queries_per_second}
         return d
 
     @staticmethod
@@ -239,6 +245,9 @@ class TableConfig:
             partition_column=d.get("partitionColumn"),
             num_partitions=int(d.get("numPartitions", 0)),
             tenant=d.get("tenant", "default"),
+            max_queries_per_second=float(
+                (d.get("quota") or {}).get("maxQueriesPerSecond", 0.0) or 0.0
+            ),
         )
 
     def to_json(self) -> str:

@@ -106,6 +106,26 @@ class TestTzTrunc:
             assert int(got) == want, (raw, got, want)
 
 
+class TestOutputUnit:
+    def test_five_arg_datetrunc_groupby(self, eng_ts):
+        """5-arg form: result in outputTimeUnit; GROUP BY decode must match
+        (review-caught: expr_int_range returned a millis range against
+        seconds values)."""
+        eng, ts = eng_ts
+        res = eng.query(
+            "SELECT DATETRUNC('year', ts, 'MILLISECONDS', 'SECONDS'), COUNT(*) FROM t "
+            "GROUP BY DATETRUNC('year', ts, 'MILLISECONDS', 'SECONDS') "
+            "ORDER BY DATETRUNC('year', ts, 'MILLISECONDS', 'SECONDS') LIMIT 10"
+        )
+        want = {}
+        for v in ts:
+            y = dt.datetime.fromtimestamp(int(v) / 1000, tz=dt.timezone.utc).year
+            k = int(dt.datetime(y, 1, 1, tzinfo=dt.timezone.utc).timestamp())  # seconds
+            want[k] = want.get(k, 0) + 1
+        got = {int(a): int(b) for a, b in res.rows}
+        assert got == want
+
+
 class TestTzStrings:
     def test_todatetime_tz(self):
         from pinot_tpu.query import scalar

@@ -41,11 +41,12 @@ class QueryQuotaManager:
     def __init__(self) -> None:
         # table -> [tokens, last_refill_monotonic]
         self._buckets: Dict[str, List[float]] = {}
+        self.clock = time.monotonic  # injectable for deterministic tests
 
     def check(self, table: str, max_qps: float, now: Optional[float] = None) -> None:
         if max_qps <= 0:
             return
-        t = time.monotonic() if now is None else now
+        t = self.clock() if now is None else now
         cap = max(1.0, float(max_qps))
         b = self._buckets.get(table)
         if b is None:
@@ -177,16 +178,24 @@ class Broker:
 
         return self.execute(parse_query(sql))
 
-    def execute(self, ctx: QueryContext) -> ResultTable:
+    def execute(self, ctx: QueryContext, _charge_quota: bool = True) -> ResultTable:
         from pinot_tpu.query.engine import apply_set_ops, resolve_subqueries
         from pinot_tpu.spi.env import apply_env_defaults
 
         apply_env_defaults(ctx.options)
         if ctx.options.get("__explain__"):
             return self._explain(ctx)
-        resolve_subqueries(ctx, self.execute)
+        # quota charges ONCE per client request — set-op operands and
+        # subqueries recurse with the quota already paid (the reference
+        # likewise charges per broker request)
+        if _charge_quota and ctx.table in self.coordinator.tables:
+            self.quota.check(
+                ctx.table, self.coordinator.tables[ctx.table].config.max_queries_per_second
+            )
+        _sub = lambda c: self.execute(c, _charge_quota=False)
+        resolve_subqueries(ctx, _sub)
         if ctx.set_ops:
-            return apply_set_ops(ctx, self.execute)
+            return apply_set_ops(ctx, _sub)
         from pinot_tpu.query.safety import Deadline
 
         t0 = time.perf_counter()
@@ -196,8 +205,6 @@ class Broker:
         table = ctx.table
         if table not in self.coordinator.tables:
             raise KeyError(f"table {table!r} not found")
-        # per-table QPS quota (checked before any work is scheduled)
-        self.quota.check(table, self.coordinator.tables[table].config.max_queries_per_second)
         self._inject_global_ranges(ctx, table)
         # hybrid tables (offline segments + a realtime manager under ONE
         # name): a TIME BOUNDARY splits the parts — offline answers

@@ -273,8 +273,13 @@ class MinionTaskManager:
         if remaps:  # one pk_map pass for all compacted segments
             for loc in um.pk_map.values():
                 m = remaps.get(loc.segment)
-                if m is not None and loc.doc in m:
-                    loc.doc = m[loc.doc]
+                if m is None:
+                    continue
+                # a tracked doc missing from the kept set was itself invalid
+                # (a delete tombstone's own row): mark it compacted-away so
+                # later invalidations/reads don't touch a reused index
+                # (review-caught stale-location bug)
+                loc.doc = m.get(loc.doc, -1)
         return report
 
     # -- RefreshSegmentTask ----------------------------------------------

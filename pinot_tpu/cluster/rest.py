@@ -111,7 +111,14 @@ class QueryServer:
                         ]
                     self._send(200, payload)
                 except Exception as e:  # noqa: BLE001 - boundary
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    from pinot_tpu.cluster.broker import QuotaExceededError
+
+                    if isinstance(e, QuotaExceededError):
+                        # the reference's 429 QUERY_QUOTA_EXCEEDED contract:
+                        # throttled clients must be able to back off
+                        self._send(429, {"error": str(e), "errorCode": "QUERY_QUOTA_EXCEEDED"})
+                    else:
+                        self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]

@@ -698,9 +698,19 @@ def expr_int_range(expr, segment) -> Optional[Tuple[int, int]]:
         out_div = TIME_UNIT_MS[str(unit_args[1]).upper()] if len(unit_args) > 1 else 1
         f = lambda x: int(date_trunc(unit, jnp.asarray([x * in_ms], dtype=jnp.int64))[0])
         if tz is not None:
-            # local truncation shifts results by at most a day either way;
-            # widen (over-approximation is safe for range sizing)
-            return ((f(lo) - MS_DAY) // out_div, (f(hi) + MS_DAY) // out_div)
+            # local truncation near a bucket boundary can land one WHOLE
+            # bucket below the UTC truncation (an instant just past the UTC
+            # year start is still in the previous local year) — widen the
+            # lower bound by the unit's span, the upper by the max zone
+            # shift (over-approximation is safe for range sizing;
+            # review-caught: ±1 day only covers sub-day units)
+            span = {
+                "year": 366 * MS_DAY,
+                "quarter": 92 * MS_DAY,
+                "month": 31 * MS_DAY,
+                "week": 7 * MS_DAY,
+            }.get(unit.lower(), MS_DAY)
+            return ((f(lo) - span) // out_div, (f(hi) + MS_DAY) // out_div)
         return (f(lo) // out_div, f(hi) // out_div)
     if op in ("year", "quarter", "month", "week", "weekofyear", "day", "dayofmonth", "hour", "minute", "second") and len(args) == 1 and args[0] is not None:
         lo, hi = args[0]

@@ -125,6 +125,8 @@ class PartitionUpsertMetadataManager:
             self._invalidate(cand)
 
     def _invalidate(self, loc: _Location) -> None:
+        if loc.doc < 0:  # compacted-away doc (delete tombstone): nothing to mask
+            return
         mask = self.valid.get(loc.segment)
         if mask is not None:
             mask[loc.doc] = False
@@ -212,6 +214,8 @@ class PartitionUpsertMetadataManager:
 
     def _read_row(self, table_mgr, loc: _Location) -> Optional[Dict[str, Any]]:
         """Point-read the winning row's values at its current location."""
+        if loc.doc < 0:  # compacted-away (tombstone): no row to read
+            return None
         for mgr in table_mgr.managers.values():
             if mgr.mutable.name == loc.segment:
                 return {f.name: mgr.mutable.value_at(f.name, loc.doc) for f in self.schema.fields}

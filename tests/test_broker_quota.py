@@ -62,10 +62,32 @@ class TestQueryQuota:
         coord.add_table(_schema(), cfg)
         coord.add_segment("t", build_segment(_schema(), _data(100), "s", table_config=cfg))
         broker = Broker(coord)
+        # frozen clock: query duration (JAX compiles!) must not refill tokens
+        broker.quota.clock = lambda: 1000.0
         broker.query("SELECT COUNT(*) FROM t")
         broker.query("SELECT COUNT(*) FROM t")
         with pytest.raises(QuotaExceededError):
             broker.query("SELECT COUNT(*) FROM t")
+        # advancing the clock refills
+        broker.quota.clock = lambda: 1000.6
+        broker.query("SELECT COUNT(*) FROM t")
+
+    def test_quota_charges_once_per_request(self):
+        """Set-op operands / subqueries must not double-charge the quota
+        (review-caught: UNION ALL on a qps=1 table could never succeed)."""
+        coord = Coordinator(replication=1)
+        coord.register_server(ServerInstance("s0"))
+        cfg = TableConfig(
+            name="t", segments=SegmentsConfig(time_column="ts"), max_queries_per_second=1.0
+        )
+        coord.add_table(_schema(), cfg)
+        coord.add_segment("t", build_segment(_schema(), _data(100), "s", table_config=cfg))
+        broker = Broker(coord)
+        broker.quota.clock = lambda: 50.0
+        r = broker.query(
+            "SELECT COUNT(*) FROM t UNION ALL SELECT COUNT(*) FROM t"
+        )
+        assert len(r.rows) == 2  # one request, one token
 
     def test_zero_quota_is_unlimited(self):
         q = QueryQuotaManager()
@@ -165,6 +187,62 @@ class TestUpsertCompaction:
         mgr.consume_all()
         r = eng.query("SELECT amount FROM o WHERE oid = 'k0' LIMIT 2")
         assert len(r.rows) == 1 and float(r.rows[0][0]) == 999.0
+
+
+class TestUpsertCompactionTombstones:
+    def test_compaction_with_delete_tombstones(self, tmp_path):
+        """A compacted-away tombstone row must not leave its pk_map location
+        pointing into the shorter segment (review-caught: a later upsert
+        would mask out a DIFFERENT key's live row)."""
+        schema = Schema(
+            "o",
+            [
+                FieldSpec("oid", DataType.STRING),
+                FieldSpec("amount", DataType.DOUBLE, role=FieldRole.METRIC),
+                FieldSpec("deleted", DataType.BOOLEAN),
+                FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+            ],
+            primary_key_columns=["oid"],
+        )
+        cfg = TableConfig(
+            "o",
+            segments=SegmentsConfig(time_column="ts"),
+            stream=StreamConfig(stream_type="memory", max_rows_per_segment=8),
+            upsert=UpsertConfig(
+                mode="FULL", comparison_column="ts", delete_record_column="deleted"
+            ),
+        )
+        stream = InMemoryStream(1)
+        mgr = RealtimeTableDataManager(schema, cfg, str(tmp_path / "t"), stream=stream)
+        rows = [
+            {"oid": f"k{i % 4}", "amount": float(i), "deleted": False, "ts": 100 + i}
+            for i in range(7)
+        ]
+        # tombstone k1 inside the first sealed segment (8 rows/seal)
+        rows.append({"oid": "k1", "amount": 0.0, "deleted": True, "ts": 200})
+        rows += [
+            {"oid": f"k{i % 4}", "amount": 50.0 + i, "deleted": False, "ts": 300 + i}
+            for i in range(4)
+        ]
+        stream.publish_many(rows, partition=0)
+        mgr.consume_all()
+        coord = Coordinator(replication=1)
+        MinionTaskManager(coord).upsert_compact("o", realtime_manager=mgr, invalid_threshold=0.01)
+        # tombstone entry is marked compacted-away, not a stale index
+        assert mgr.upsert.pk_map[("k1",)].doc == -1 or not mgr.upsert.pk_map[("k1",)].deleted
+        from pinot_tpu.query.engine import QueryEngine
+
+        eng = QueryEngine()
+        eng.register_table(schema, cfg)
+        eng.attach_realtime("o", mgr)
+        # a NEWER row revives k1; other keys keep exactly one live row each
+        stream.publish({"oid": "k1", "amount": 77.0, "deleted": False, "ts": 999}, partition=0)
+        mgr.consume_all()
+        res = eng.query("SELECT oid, amount FROM o ORDER BY oid LIMIT 10")
+        got = {a: float(b) for a, b in res.rows}
+        # latest per key: k0 ts=300 amount=50, k1 revived at ts=999,
+        # k2 ts=302 amount=52, k3 ts=303 amount=53
+        assert got == {"k0": 50.0, "k1": 77.0, "k2": 52.0, "k3": 53.0}, got
 
 
 class TestRefreshSegment:

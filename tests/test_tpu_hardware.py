@@ -102,6 +102,77 @@ got_map = {int(k): float(s) for k, s in zip(uniq[present], np.asarray(partials[0
 assert got_map == hsum, "sparse group tables mismatch"
 out["sparse_groupby_exact"] = True
 
+# 6. distributed engine on the ambient device: range-index WORD SLICING
+# through forced MACRO-BATCHED launches (round 5) — end-to-end vs numpy
+from pinot_tpu.parallel.engine import DistributedEngine
+from pinot_tpu.parallel.stacked import StackedTable
+from pinot_tpu.spi.config import IndexingConfig, TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+from pinot_tpu.sql.parser import parse_query
+
+n2 = 1 << 16
+schema = Schema("t", [
+    FieldSpec("g", DataType.INT),
+    FieldSpec("q", DataType.INT),
+    FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+])
+data = {
+    "g": rng.integers(0, 50, n2).astype(np.int32),
+    "q": rng.integers(0, 100, n2).astype(np.int32),
+    "v": rng.integers(-10**9, 10**9, n2).astype(np.int64),
+}
+cfg = TableConfig("t", indexing=IndexingConfig(range_index_columns=["q"]))
+eng = DistributedEngine(launch_bytes=n2 * 3)  # forces several launches
+st = StackedTable.build(schema, dict(data), eng.num_devices, table_config=cfg)
+eng.register_table("t", st)
+ctx = parse_query("SELECT g, SUM(v), COUNT(*) FROM t WHERE q < 37 GROUP BY g ORDER BY g LIMIT 64")
+plan = eng._plan(ctx, st)
+assert len(plan.batch_offsets) >= 2, plan.batch_offsets
+r = eng.execute(ctx)
+assert ("q", "range") in r.stats.filter_index_uses
+fm = data["q"] < 37
+esum, ecnt = {}, {}
+for g, v, mm in zip(data["g"], data["v"], fm):
+    if mm:
+        esum[g] = esum.get(g, 0) + int(v)
+        ecnt[g] = ecnt.get(g, 0) + 1
+got_rows = {int(a): (int(b), int(c)) for a, b, c in r.rows}
+assert got_rows == {int(k): (esum[k], ecnt[k]) for k in esum}, "batched range group-by mismatch"
+out["range_index_macro_batched_exact"] = True
+
+# 7. sketches on the device: exact presence DISTINCTCOUNT + HLL tolerance
+rdc = eng.query("SELECT DISTINCTCOUNT(g) FROM t")
+assert int(rdc.rows[0][0]) == len(np.unique(data["g"])), "DISTINCTCOUNT mismatch"
+true_v = len(np.unique(data["v"]))
+rhll = eng.query("SELECT DISTINCTCOUNTHLL(v) FROM t")
+assert abs(int(rhll.rows[0][0]) - true_v) / true_v < 0.1, "HLL estimate off"
+out["sketches_on_device"] = True
+
+# 8. MV explode GROUP BY on the device (single-node engine kernels)
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+
+mv_schema = Schema("m", [
+    FieldSpec("tags", DataType.STRING, single_value=False),
+    FieldSpec("x", DataType.INT, role=FieldRole.METRIC),
+])
+tags_pool = np.asarray(["a", "b", "c", "d"])
+mv_rows = np.empty(5000, dtype=object)
+for i in range(5000):
+    mv_rows[i] = list(rng.choice(tags_pool, int(rng.integers(0, 4))))
+xs = rng.integers(0, 100, 5000).astype(np.int32)
+qe = QueryEngine()
+qe.register_table(mv_schema)
+qe.add_segment("m", build_segment(mv_schema, {"tags": mv_rows, "x": xs}, "s0"))
+rmv = qe.query("SELECT tags, COUNT(*), SUM(x) FROM m GROUP BY tags ORDER BY tags LIMIT 10")
+emv = {}
+for row_tags, x in zip(mv_rows, xs):
+    for t in row_tags:
+        c0, s0 = emv.get(t, (0, 0))
+        emv[t] = (c0 + 1, s0 + int(x))
+assert {a: (int(b), int(c)) for a, b, c in rmv.rows} == emv, "MV explode mismatch"
+out["mv_explode_exact"] = True
+
 print(json.dumps(out))
 """
 
@@ -134,9 +205,10 @@ def accelerator():
 
 
 def test_kernel_exactness_on_accelerator(accelerator):
-    """chunked32 limb sums, bitmap unpack, and the sparse sort kernel are
-    bit-exact ON THE REAL ACCELERATOR, not just the CPU mesh."""
-    res = _run(_ASSERTIONS, timeout=580)
+    """chunked32 limb sums, bitmap unpack, the sparse sort kernel, macro-
+    batched range-index queries, device sketches, and MV explode are
+    correct ON THE REAL ACCELERATOR, not just the CPU mesh."""
+    res = _run(_ASSERTIONS, timeout=1100)
     assert res.returncode == 0, f"TPU assertions failed:\n{res.stderr[-2000:]}"
     out = json.loads(res.stdout.strip().splitlines()[-1])
     assert out == {
@@ -147,4 +219,7 @@ def test_kernel_exactness_on_accelerator(accelerator):
         "fused_sum64_exact": True,
         "sum64_neg_exact": True,
         "sparse_groupby_exact": True,
+        "range_index_macro_batched_exact": True,
+        "sketches_on_device": True,
+        "mv_explode_exact": True,
     }

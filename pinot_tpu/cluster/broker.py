@@ -178,21 +178,23 @@ class Broker:
 
         return self.execute(parse_query(sql))
 
-    def execute(self, ctx: QueryContext, _charge_quota: bool = True) -> ResultTable:
+    def execute(self, ctx: QueryContext, _charged: frozenset = frozenset()) -> ResultTable:
         from pinot_tpu.query.engine import apply_set_ops, resolve_subqueries
         from pinot_tpu.spi.env import apply_env_defaults
 
         apply_env_defaults(ctx.options)
         if ctx.options.get("__explain__"):
             return self._explain(ctx)
-        # quota charges ONCE per client request — set-op operands and
-        # subqueries recurse with the quota already paid (the reference
-        # likewise charges per broker request)
-        if _charge_quota and ctx.table in self.coordinator.tables:
+        # quota charges ONCE per client request PER TABLE — set-op operands
+        # and subqueries recurse with their outer tables pre-paid, but a
+        # different table inside the request still pays its own quota
+        # (review-caught: inner tables must not bypass their limits)
+        if ctx.table not in _charged and ctx.table in self.coordinator.tables:
             self.quota.check(
                 ctx.table, self.coordinator.tables[ctx.table].config.max_queries_per_second
             )
-        _sub = lambda c: self.execute(c, _charge_quota=False)
+        charged = _charged | {ctx.table}
+        _sub = lambda c: self.execute(c, _charged=charged)
         resolve_subqueries(ctx, _sub)
         if ctx.set_ops:
             return apply_set_ops(ctx, _sub)

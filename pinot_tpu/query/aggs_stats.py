@@ -489,6 +489,110 @@ class IntegerTupleSketchFunction(AggFunction):
         return np.dtype(np.int64) if self.estimate == "distinct" else np.dtype(np.float64)
 
 
+# ---------------------------------------------------------------------------
+# Funnel family: per-step correlate-key presence bitmaps
+# ---------------------------------------------------------------------------
+class FunnelCountFunction(AggFunction):
+    """FUNNELCOUNT(STEPS(cond1, ..., condS), CORRELATEBY(col)) — per step s,
+    how many correlate keys matched ALL of steps 1..s (set-intersection
+    funnel, the reference's bitmap strategy:
+    pinot-core/.../query/aggregation/function/funnel/
+    FunnelCountAggregationFunction.java).
+
+    TPU form: per-step presence bitmaps over the correlate key domain
+    (scatter-or via group_count>0) — an additive [S, domain] int32 tensor
+    partial that merges by max and psums across shards; the prefix-AND and
+    counting happen at final over the table-sized array.  Keys need a
+    shared dictionary or bounded int range (like exact DISTINCTCOUNT)."""
+
+    name = "funnelcount"
+    needs_codes = True
+    needs_binding = True
+    needs_extra_exprs = True
+    vector_fields = True
+    fields = ("present",)
+    mode = "counts"  # counts | complete | maxstep
+    input_kind = "codes"
+
+    def __init__(self, domain: int = 0, base: int = 0, input_kind: str = "codes"):
+        self.domain = domain
+        self.base = base
+        self.input_kind = input_kind
+
+    def _rebind(self, **kw):
+        out = type(self)(**kw)
+        return out
+
+    def bind_column(self, info: ColumnBinding):
+        if info.kind == "dict":
+            return self._rebind(domain=info.domain, input_kind="codes")
+        if info.kind == "rawint":
+            return self._rebind(domain=info.domain, base=info.base, input_kind="values_offset")
+        raise NotImplementedError(
+            f"{self.name.upper()} needs a dictionary or bounded-int CORRELATEBY column"
+        )
+
+    def partial(self, values, mask):
+        import jax.numpy as jnp
+
+        codes, *steps = values
+        _check_cell_budget(self.name, len(steps), self.domain)
+        rows = [
+            (ops.group_count(mask & s.astype(bool), codes, self.domain) > 0).astype(jnp.int32)
+            for s in steps
+        ]
+        return {"present": jnp.stack(rows, axis=0)}  # [S, domain]
+
+    def partial_grouped(self, values, mask, keys, num_groups):
+        import jax.numpy as jnp
+
+        codes, *steps = values
+        _check_cell_budget(self.name, num_groups * len(steps), self.domain)
+        flat = keys.astype(jnp.int32) * np.int32(self.domain) + codes
+        cells = num_groups * self.domain
+        rows = [
+            (ops.group_count(mask & s.astype(bool), flat, cells) > 0)
+            .astype(jnp.int32)
+            .reshape(num_groups, self.domain)
+            for s in steps
+        ]
+        return {"present": jnp.stack(rows, axis=1)}  # [G, S, domain]
+
+    def merge(self, a, b):
+        return {"present": np.maximum(np.asarray(a["present"]), np.asarray(b["present"]))}
+
+    def final(self, p):
+        pres = np.asarray(p["present"])
+        one = pres.ndim == 2
+        if one:
+            pres = pres[None]  # [1, S, domain]
+        prefix = np.cumprod(pres > 0, axis=1)  # AND over steps 1..s
+        if self.mode == "counts":
+            counts = prefix.sum(axis=2)  # [G, S]
+            out = np.empty(counts.shape[0], dtype=object)
+            for g in range(counts.shape[0]):
+                out[g] = [int(c) for c in counts[g]]
+        elif self.mode == "complete":
+            out = prefix[:, -1, :].sum(axis=1).astype(np.int64)
+        else:  # maxstep: deepest step any correlate key completed
+            per_key = prefix.sum(axis=1)  # [G, domain] leading-True runs
+            out = per_key.max(axis=1).astype(np.int64)
+        return out[0] if one else out
+
+    def final_dtype(self):
+        return np.dtype(object) if self.mode == "counts" else np.dtype(np.int64)
+
+
+class FunnelCompleteCountFunction(FunnelCountFunction):
+    name = "funnelcompletecount"
+    mode = "complete"
+
+
+class FunnelMaxStepFunction(FunnelCountFunction):
+    name = "funnelmaxstep"
+    mode = "maxstep"
+
+
 class SumValuesTupleSketchFunction(IntegerTupleSketchFunction):
     name = "sumvaluesintegersumtuplesketch"
     estimate = "sum"
@@ -510,6 +614,9 @@ for _cls in (
     IntegerTupleSketchFunction,
     SumValuesTupleSketchFunction,
     AvgValueTupleSketchFunction,
+    FunnelCountFunction,
+    FunnelCompleteCountFunction,
+    FunnelMaxStepFunction,
 ):
     register(_cls())
 
@@ -525,5 +632,8 @@ for _alias, _target in (
     ("arg_min", "exprmin"),
     ("covarpop", "covar_pop"),
     ("covarsamp", "covar_samp"),
+    ("funnel_count", "funnelcount"),
+    ("funnel_complete_count", "funnelcompletecount"),
+    ("funnel_max_step", "funnelmaxstep"),
 ):
     _REGISTRY[_alias] = _REGISTRY[_target]

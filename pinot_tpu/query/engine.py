@@ -39,11 +39,12 @@ class TableState:
 
 
 class QueryEngine:
-    def __init__(self, memory_budget_bytes: int = 8 << 30) -> None:
-        from pinot_tpu.query.safety import MemoryAccountant
+    def __init__(self, memory_budget_bytes: int = 8 << 30, secondary_slots: int = 2) -> None:
+        from pinot_tpu.query.safety import MemoryAccountant, WorkloadScheduler
 
         self.tables: Dict[str, TableState] = {}
         self.accountant = MemoryAccountant(memory_budget_bytes)
+        self.scheduler = WorkloadScheduler(secondary_slots)
 
     # -- table registry (controller-lite) -------------------------------
     def register_table(self, schema: Schema, config: Optional[TableConfig] = None) -> None:
@@ -93,7 +94,14 @@ class QueryEngine:
         est = sum(
             estimate_segment_bytes(ctx, seg, _needed_columns(ctx, seg)) for seg in segments
         )
-        qid = self.accountant.acquire(est)
+        # workload tier gate first (BinaryWorkloadScheduler): secondary
+        # queries wait for a slot before charging memory
+        release_slot = self.scheduler.acquire(ctx, deadline)
+        try:
+            qid = self.accountant.acquire(est)
+        except BaseException:
+            release_slot()
+            raise
         stats = ExecutionStats()
         results = []
         try:
@@ -136,6 +144,7 @@ class QueryEngine:
             raise
         finally:
             self.accountant.release(qid)
+            release_slot()
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         out.stats.trace = trace.finish()
         METRICS.timer("queryLatency").update(out.stats.time_ms)

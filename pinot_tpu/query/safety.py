@@ -67,6 +67,40 @@ def estimate_segment_bytes(ctx: QueryContext, segment, needed_columns: Optional[
     return total
 
 
+class WorkloadScheduler:
+    """Two-tier workload isolation (BinaryWorkloadScheduler analog,
+    pinot-core/.../core/query/scheduler/BinaryWorkloadScheduler.java).
+
+    PRIMARY (interactive) queries are never queued.  SECONDARY queries —
+    marked with the `isSecondaryWorkload` query option, the reference's
+    contract for misbehaving/batch traffic — compete for a small semaphore
+    and wait at most their remaining deadline (default 1s) for a slot, so
+    a batch scan burst cannot starve interactive latency."""
+
+    def __init__(self, secondary_slots: int = 2):
+        self.secondary_slots = secondary_slots
+        self._sem = threading.BoundedSemaphore(secondary_slots)
+
+    @staticmethod
+    def is_secondary(ctx: QueryContext) -> bool:
+        v = ctx.options.get("isSecondaryWorkload")
+        return str(v).lower() in ("1", "true", "yes") if v is not None else False
+
+    def acquire(self, ctx: QueryContext, deadline: Optional["Deadline"] = None):
+        """Returns a release callable (no-op for primary workloads)."""
+        if not self.is_secondary(ctx):
+            return lambda: None
+        wait_s = 1.0
+        if deadline is not None and deadline.expires_at is not None:
+            wait_s = max(0.0, deadline.expires_at - time.perf_counter())
+        if not self._sem.acquire(timeout=wait_s):
+            raise AdmissionError(
+                f"secondary workload queue full ({self.secondary_slots} slots); "
+                "retry later or run without isSecondaryWorkload"
+            )
+        return self._sem.release
+
+
 class MemoryAccountant:
     """Process-wide device-memory admission (budget in bytes).
 

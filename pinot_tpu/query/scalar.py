@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import math
+import re
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax.numpy as jnp
@@ -454,6 +455,122 @@ DICT_FNS: Dict[str, Callable] = {
     "containsstr": _sv_num(lambda v, p: int(str(p) in v), np.uint8),
 }
 
+
+# -- string/url/hash breadth (StringFunctions.java, UrlFunctions.java,
+# HashFunctions.java; regexpExtract/regexpReplace from RegexpFunctions) ----
+def _split_part(v: str, delim, a, *b):
+    """splitPart(input, delim, index) or the reference's 4-arg
+    (input, delim, limit, index) form — limit bounds the SPLIT COUNT
+    (StringFunctions.splitPart), not a default value."""
+    if b:
+        limit, i = int(a), int(b[0])
+        parts = str(v).split(str(delim), max(0, limit - 1))
+    else:
+        i = int(a)
+        parts = str(v).split(str(delim))
+    if 0 <= i < len(parts):
+        return parts[i]
+    return "null"  # Pinot's miss marker
+
+
+def _regexp_extract(v: str, pattern, *args):
+    group = int(args[0]) if args else 0
+    default = str(args[1]) if len(args) > 1 else ""
+    m = re.search(str(pattern), str(v))
+    if m is None:
+        return default
+    try:
+        return m.group(group) or default
+    except IndexError:
+        return default
+
+
+def _regexp_replace(v: str, pattern, repl, *args):
+    """regexpReplace(value, regex, replace[, matchStartPos[, occurrence
+    [, flags]]]) — occurrence k >= 0 replaces only the k-th match (0-based),
+    -1 (default) replaces all; flags: 'i' case-insensitive
+    (RegexpReplaceTransformFunction signature)."""
+    s = str(v)
+    start = int(args[0]) if args else 0
+    occurrence = int(args[1]) if len(args) > 1 else -1
+    fl = re.IGNORECASE if len(args) > 2 and "i" in str(args[2]).lower() else 0
+    head, tail = s[:start], s[start:]
+    if occurrence < 0:
+        return head + re.sub(str(pattern), str(repl), tail, flags=fl)
+    rx = re.compile(str(pattern), fl)
+    k = -1
+    out = []
+    pos = 0
+    for m in rx.finditer(tail):
+        k += 1
+        if k == occurrence:
+            out.append(tail[pos : m.start()])
+            out.append(m.expand(str(repl)))
+            pos = m.end()
+            break
+    out.append(tail[pos:])
+    return head + "".join(out)
+
+
+def _hash_fn(algo):
+    import hashlib
+
+    def apply(v):
+        h = hashlib.new(algo)
+        h.update(v.encode() if isinstance(v, str) else bytes(v))
+        return h.hexdigest()
+
+    return apply
+
+
+def _url_encode(v: str) -> str:
+    from urllib.parse import quote_plus
+
+    return quote_plus(str(v))
+
+
+def _url_decode(v: str) -> str:
+    from urllib.parse import unquote_plus
+
+    return unquote_plus(str(v))
+
+
+def _b64(v: str) -> str:
+    import base64
+
+    return base64.b64encode(v.encode() if isinstance(v, str) else bytes(v)).decode()
+
+
+def _b64d(v: str) -> str:
+    import base64
+
+    return base64.b64decode(str(v)).decode()
+
+
+DICT_FNS.update(
+    {
+        "splitpart": _sv(_split_part),
+        "split_part": _sv(_split_part),
+        "repeat": _sv(lambda v, n, *sep: (str(sep[0]) if sep else "").join([v] * int(n))),
+        "regexpextract": _sv(_regexp_extract),
+        "regexp_extract": _sv(_regexp_extract),
+        "regexpreplace": _sv(_regexp_replace),
+        "regexp_replace": _sv(_regexp_replace),
+        "urlencode": _sv(_url_encode),
+        "urldecode": _sv(_url_decode),
+        "encodeurl": _sv(_url_encode),
+        "decodeurl": _sv(_url_decode),
+        "md5": _sv(_hash_fn("md5")),
+        "sha": _sv(_hash_fn("sha1")),
+        "sha256": _sv(_hash_fn("sha256")),
+        "sha512": _sv(_hash_fn("sha512")),
+        "tobase64": _sv(_b64),
+        "frombase64": _sv(_b64d),
+        "codepoint": _sv_num(lambda v: ord(str(v)[0]) if str(v) else 0),
+        "chr": _sv(lambda v: chr(int(v))),
+    }
+)
+
 def _json_extract(values: np.ndarray, path, rtype, default=None) -> np.ndarray:
     """JSON_EXTRACT_SCALAR(col, '$.path', 'type'[, default]) over dictionary
     values (JsonExtractScalarTransformFunction analog, evaluated per
@@ -575,7 +692,13 @@ def to_datetime(ms, fmt: str, tz_name: Optional[str] = None):
     return out
 
 STRING_RESULT_DICT_FNS = frozenset(
-    {"upper", "lower", "trim", "ltrim", "rtrim", "reverse", "substr", "substring", "concat", "replace", "lpad", "rpad"}
+    {
+        "upper", "lower", "trim", "ltrim", "rtrim", "reverse", "substr", "substring",
+        "concat", "replace", "lpad", "rpad",
+        "splitpart", "split_part", "repeat", "regexpextract", "regexp_extract",
+        "regexpreplace", "regexp_replace", "urlencode", "urldecode", "encodeurl",
+        "decodeurl", "md5", "sha", "sha256", "sha512", "tobase64", "frombase64", "chr",
+    }
 )
 
 
@@ -710,7 +833,10 @@ def expr_int_range(expr, segment) -> Optional[Tuple[int, int]]:
                 "month": 31 * MS_DAY,
                 "week": 7 * MS_DAY,
             }.get(unit.lower(), MS_DAY)
-            return ((f(lo) - span) // out_div, (f(hi) + MS_DAY) // out_div)
+            # symmetric: zones AHEAD of UTC can truncate one whole bucket
+            # ABOVE the UTC truncation too (review-caught: Pacific/Auckland
+            # year boundary)
+            return ((f(lo) - span) // out_div, (f(hi) + span) // out_div)
         return (f(lo) // out_div, f(hi) // out_div)
     if op in ("year", "quarter", "month", "week", "weekofyear", "day", "dayofmonth", "hour", "minute", "second") and len(args) == 1 and args[0] is not None:
         lo, hi = args[0]

@@ -752,6 +752,20 @@ class _Parser:
         args = list(e.args)
         if e.op == "count" and len(args) == 1 and args[0].is_column and args[0].op == "*":
             return AggregationSpec("count", None)
+        if e.op.replace("_", "") in ("funnelcount", "funnelcompletecount", "funnelmaxstep"):
+            # FUNNELCOUNT(STEPS(c1, c2, ...), CORRELATEBY(col)) -> the
+            # correlate column is the (codes) input, the step conditions are
+            # extra boolean expressions (FunnelCountAggregationFunction)
+            steps = next((a for a in args if not a.is_literal and a.op == "steps"), None)
+            corr = next(
+                (a for a in args if not a.is_literal and a.op in ("correlateby", "correlatedby", "correlate_by")),
+                None,
+            )
+            if steps is None or corr is None or not steps.args or len(corr.args) != 1:
+                raise SqlParseError(
+                    f"{e.op.upper()} needs STEPS(cond, ...) and CORRELATEBY(column) arguments"
+                )
+            return AggregationSpec(e.op, corr.args[0], extra_exprs=tuple(steps.args))
         expr = args[0] if args else None
         lits = tuple(a.value for a in args[1:] if a.is_literal)
         extra = tuple(a for a in args[1:] if not a.is_literal)
@@ -992,6 +1006,18 @@ class _Parser:
                     args.append(Expr.col("*"))
                     self.expect_op(")")
                     return Expr.call(name, *args)
+                # STEPS(cond, cond, ...) — the funnel family's step
+                # conditions are BOOLEAN expressions; convert each through
+                # the CASE condition machinery into boolean expression ops
+                # (FunnelCountAggregationFunction STEPS syntax)
+                if str(name).lower() == "steps":
+                    conds: List[Expr] = []
+                    if not self.at_op(")"):
+                        conds.append(_filter_to_expr(self.boolean_expr()))
+                        while self.accept_op(","):
+                            conds.append(_filter_to_expr(self.boolean_expr()))
+                    self.expect_op(")")
+                    return Expr.call("steps", *conds)
                 if not self.at_op(")"):
                     # DISTINCT inside agg: count(distinct x) -> distinctcount
                     if self.accept_kw("distinct"):

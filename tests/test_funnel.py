@@ -1,0 +1,125 @@
+"""Funnel aggregation family (VERDICT r4 missing #3 tail).
+
+Reference model: pinot-core/.../query/aggregation/function/funnel/
+FunnelCountAggregationFunction.java (bitmap set-intersection strategy),
+FunnelCompleteCount / FunnelMaxStep siblings.  Golden model: python sets.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+N = 30_000
+
+
+@pytest.fixture(scope="module")
+def funnel_world():
+    rng = np.random.default_rng(71)
+    uid = rng.integers(0, 800, N).astype(np.int64)
+    url = rng.choice(["/home", "/product", "/cart", "/checkout"], N, p=[0.5, 0.3, 0.15, 0.05])
+    country = rng.choice(["us", "de"], N)
+    schema = Schema(
+        "events",
+        [
+            FieldSpec("uid", DataType.LONG),
+            FieldSpec("url", DataType.STRING),
+            FieldSpec("country", DataType.STRING),
+        ],
+    )
+    eng = QueryEngine()
+    eng.register_table(schema)
+    # 3 segments to exercise the presence-bitmap merge
+    bounds = np.linspace(0, N, 4).astype(int)
+    for i in range(3):
+        chunk = {
+            "uid": uid[bounds[i] : bounds[i + 1]],
+            "url": url[bounds[i] : bounds[i + 1]],
+            "country": country[bounds[i] : bounds[i + 1]],
+        }
+        eng.add_segment("events", build_segment(schema, chunk, f"s{i}"))
+    return eng, uid, url, country
+
+
+def _step_sets(uid, url, conds):
+    return [set(uid[url == c]) for c in conds]
+
+
+CONDS = ["/home", "/product", "/cart", "/checkout"]
+
+
+class TestFunnelCount:
+    def test_counts_per_step(self, funnel_world):
+        eng, uid, url, _ = funnel_world
+        got = eng.query(
+            "SELECT FUNNELCOUNT(STEPS(url = '/home', url = '/product', url = '/cart', "
+            "url = '/checkout'), CORRELATEBY(uid)) FROM events"
+        ).rows[0][0]
+        sets = _step_sets(uid, url, CONDS)
+        want = []
+        acc = None
+        for s in sets:
+            acc = s if acc is None else (acc & s)
+            want.append(len(acc))
+        assert got == want
+
+    def test_filtered(self, funnel_world):
+        eng, uid, url, country = funnel_world
+        got = eng.query(
+            "SELECT FUNNELCOUNT(STEPS(url = '/home', url = '/cart'), CORRELATEBY(uid)) "
+            "FROM events WHERE country = 'us'"
+        ).rows[0][0]
+        sel = country == "us"
+        sets = _step_sets(uid[sel], url[sel], ["/home", "/cart"])
+        assert got == [len(sets[0]), len(sets[0] & sets[1])]
+
+    def test_complete_and_maxstep(self, funnel_world):
+        eng, uid, url, _ = funnel_world
+        row = eng.query(
+            "SELECT FUNNELCOMPLETECOUNT(STEPS(url = '/home', url = '/product', url = '/cart', "
+            "url = '/checkout'), CORRELATEBY(uid)), "
+            "FUNNELMAXSTEP(STEPS(url = '/home', url = '/product', url = '/cart', "
+            "url = '/checkout'), CORRELATEBY(uid)) FROM events"
+        ).rows[0]
+        sets = _step_sets(uid, url, CONDS)
+        complete = sets[0] & sets[1] & sets[2] & sets[3]
+        assert int(row[0]) == len(complete)
+        # maxstep: deepest prefix any uid completes
+        best = 0
+        acc = None
+        for i, s in enumerate(sets):
+            acc = s if acc is None else (acc & s)
+            if acc:
+                best = i + 1
+        assert int(row[1]) == best
+
+    def test_grouped_funnel(self, funnel_world):
+        eng, uid, url, country = funnel_world
+        res = eng.query(
+            "SELECT country, FUNNELCOUNT(STEPS(url = '/home', url = '/product'), "
+            "CORRELATEBY(uid)) FROM events GROUP BY country ORDER BY country"
+        )
+        for c, counts in res.rows:
+            sel = country == c
+            sets = _step_sets(uid[sel], url[sel], ["/home", "/product"])
+            assert counts == [len(sets[0]), len(sets[0] & sets[1])], c
+
+    def test_complex_step_conditions(self, funnel_world):
+        eng, uid, url, country = funnel_world
+        got = eng.query(
+            "SELECT FUNNELCOUNT(STEPS(url = '/home' AND country = 'us', "
+            "url IN ('/cart', '/checkout')), CORRELATEBY(uid)) FROM events"
+        ).rows[0][0]
+        s1 = set(uid[(url == "/home") & (country == "us")])
+        s2 = set(uid[np.isin(url, ["/cart", "/checkout"])])
+        assert got == [len(s1), len(s1 & s2)]
+
+
+def test_underscore_aliases(funnel_world):
+    eng, uid, url, _ = funnel_world
+    got = eng.query(
+        "SELECT FUNNEL_COUNT(STEPS(url = '/home', url = '/cart'), CORRELATEBY(uid)) FROM events"
+    ).rows[0][0]
+    sets = _step_sets(uid, url, ["/home", "/cart"])
+    assert got == [len(sets[0]), len(sets[0] & sets[1])]

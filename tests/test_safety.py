@@ -138,3 +138,46 @@ class TestEnvConfigLayering:
         # ...but an explicit per-query SET wins over the env layer
         res2 = eng.query("SET numGroupsLimit = 1000; SELECT v, COUNT(*) FROM t GROUP BY v LIMIT 1000")
         assert len(res2.rows) > 7
+
+
+class TestWorkloadScheduler:
+    """BinaryWorkloadScheduler analog: secondary workload isolation."""
+
+    def test_primary_never_queued(self):
+        from pinot_tpu.query.ir import QueryContext
+        from pinot_tpu.query.safety import WorkloadScheduler
+
+        ws = WorkloadScheduler(secondary_slots=1)
+        ctx = QueryContext(table="t", select_list=[])
+        rels = [ws.acquire(ctx) for _ in range(10)]  # primary: unbounded
+        for r in rels:
+            r()
+
+    def test_secondary_bounded(self):
+        from pinot_tpu.query.ir import QueryContext
+        from pinot_tpu.query.safety import AdmissionError, Deadline, WorkloadScheduler
+
+        ws = WorkloadScheduler(secondary_slots=2)
+        ctx = QueryContext(table="t", select_list=[], options={"isSecondaryWorkload": "true"})
+        d = Deadline(50.0)  # 50ms: don't block the test
+        r1 = ws.acquire(ctx, d)
+        r2 = ws.acquire(ctx, d)
+        with pytest.raises(AdmissionError):
+            ws.acquire(ctx, Deadline(50.0))
+        r1()
+        r3 = ws.acquire(ctx, Deadline(50.0))  # freed slot admits again
+        r3(); r2()
+
+    def test_engine_option_roundtrip(self):
+        import numpy as np
+
+        from pinot_tpu.query.engine import QueryEngine
+        from pinot_tpu.segment.builder import build_segment
+        from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+        schema = Schema("w", [FieldSpec("v", DataType.INT, role=FieldRole.METRIC)])
+        eng = QueryEngine(secondary_slots=1)
+        eng.register_table(schema)
+        eng.add_segment("w", build_segment(schema, {"v": np.arange(100, dtype=np.int32)}, "s0"))
+        r = eng.query("SET isSecondaryWorkload = true; SELECT COUNT(*) FROM w")
+        assert int(r.rows[0][0]) == 100

@@ -150,3 +150,28 @@ class TestTzStrings:
         eng, _ = eng_ts
         with pytest.raises(ValueError):
             eng.query("SELECT HOUR(ts, 'Not/AZone'), COUNT(*) FROM t GROUP BY HOUR(ts, 'Not/AZone') LIMIT 5")
+
+
+def test_tz_ahead_of_utc_year_trunc(eng_ts):
+    """Zones ahead of UTC can truncate one bucket ABOVE the UTC truncation
+    (review-caught: Pacific/Auckland year boundary produced garbage keys)."""
+    import numpy as np
+
+    from pinot_tpu.query.engine import QueryEngine
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+    base = int(dt.datetime(2023, 12, 31, 22, 0, tzinfo=dt.timezone.utc).timestamp() * 1000)
+    ts = base + np.arange(10, dtype=np.int64) * 60_000
+    schema = Schema("a", [FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME)])
+    eng = QueryEngine()
+    eng.register_table(schema)
+    eng.add_segment("a", build_segment(schema, {"ts": ts}, "s0"))
+    res = eng.query(
+        "SELECT DATETRUNC('year', ts, 'MILLISECONDS', 'Pacific/Auckland'), COUNT(*) FROM a "
+        "GROUP BY DATETRUNC('year', ts, 'MILLISECONDS', 'Pacific/Auckland') LIMIT 5"
+    )
+    z = ZoneInfo("Pacific/Auckland")
+    # all rows are local 2024 (UTC+13): bucket = 2024-01-01 local midnight
+    want = int(dt.datetime(2024, 1, 1, tzinfo=z).timestamp() * 1000)
+    assert [(int(a), int(b)) for a, b in res.rows] == [(want, 10)]

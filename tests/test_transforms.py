@@ -312,3 +312,96 @@ class TestSdfDatetime:
         import datetime as dt2
 
         assert parsed[0] == int(dt2.datetime(2024, 3, 5, 6, 7, 8, tzinfo=dt2.timezone.utc).timestamp() * 1000)
+
+
+class TestStringBreadth:
+    """String/URL/hash transform breadth (StringFunctions.java,
+    UrlFunctions.java, HashFunctions.java, RegexpFunctions)."""
+
+    @pytest.fixture(scope="class")
+    def seng(self):
+        import numpy as np
+
+        from pinot_tpu.query.engine import QueryEngine
+        from pinot_tpu.segment.builder import build_segment
+        from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+        schema = Schema(
+            "s",
+            [
+                FieldSpec("path", DataType.STRING),
+                FieldSpec("v", DataType.INT, role=FieldRole.METRIC),
+            ],
+        )
+        paths = np.asarray(
+            ["/api/users/42?q=a b", "/api/orders/7", "/web/home", "/api/users/9"] * 25,
+            dtype=object,
+        )
+        eng = QueryEngine()
+        eng.register_table(schema)
+        eng.add_segment(
+            "s", build_segment(schema, {"path": paths, "v": np.arange(100, dtype=np.int32)}, "s0")
+        )
+        return eng
+
+    def test_splitpart_groupby(self, seng):
+        r = seng.query(
+            "SELECT SPLITPART(path, '/', 1), COUNT(*) FROM s "
+            "GROUP BY SPLITPART(path, '/', 1) ORDER BY SPLITPART(path, '/', 1)"
+        )
+        assert [(a, int(b)) for a, b in r.rows] == [("api", 75), ("web", 25)]
+
+    def test_regexp_extract_filter(self, seng):
+        r = seng.query(
+            "SELECT COUNT(*) FROM s WHERE REGEXPEXTRACT(path, '/api/([a-z]+)/', 1) = 'users'"
+        )
+        assert int(r.rows[0][0]) == 50
+
+    def test_regexp_replace(self, seng):
+        r = seng.query(
+            "SELECT REGEXPREPLACE(path, '[0-9]+', 'N'), COUNT(*) FROM s "
+            "GROUP BY REGEXPREPLACE(path, '[0-9]+', 'N') ORDER BY REGEXPREPLACE(path, '[0-9]+', 'N') LIMIT 5"
+        )
+        names = [a for a, _ in r.rows]
+        assert "/api/users/N?q=a b" in names and "/api/orders/N" in names
+
+    def test_url_and_hash(self, seng):
+        import hashlib
+        from urllib.parse import quote_plus
+
+        r = seng.query(
+            "SELECT URLENCODE(path), MD5(path), SHA256(path) FROM s ORDER BY path LIMIT 1"
+        )
+        enc, md5v, sha = r.rows[0]
+        # first path in sorted order
+        p = "/api/orders/7"
+        assert enc == quote_plus(p)
+        assert md5v == hashlib.md5(p.encode()).hexdigest()
+        assert sha == hashlib.sha256(p.encode()).hexdigest()
+
+    def test_base64_and_codepoint(self, seng):
+        import base64
+
+        r = seng.query("SELECT TOBASE64(path), CODEPOINT(path) FROM s ORDER BY path LIMIT 1")
+        assert r.rows[0][0] == base64.b64encode(b"/api/orders/7").decode()
+        assert int(r.rows[0][1]) == ord("/")
+
+    def test_splitpart_limit_form(self):
+        """4-arg form is (input, delim, limit, index) per StringFunctions."""
+        from pinot_tpu.query.scalar import DICT_FNS
+        import numpy as np
+
+        vals = np.asarray(["a b c"], dtype=object)
+        assert DICT_FNS["splitpart"](vals, " ", 2, 1)[0] == "b c"
+        assert DICT_FNS["splitpart"](vals, " ", 2)[0] == "c"
+        assert DICT_FNS["splitpart"](vals, " ", 9)[0] == "null"
+
+    def test_regexp_replace_occurrence_and_flags(self):
+        from pinot_tpu.query.scalar import DICT_FNS
+        import numpy as np
+
+        vals = np.asarray(["a1b2c3"], dtype=object)
+        assert DICT_FNS["regexpreplace"](vals, "[0-9]", "N")[0] == "aNbNcN"
+        assert DICT_FNS["regexpreplace"](vals, "[0-9]", "N", 0, 1)[0] == "a1bNc3"
+        assert DICT_FNS["regexpreplace"](vals, "[0-9]", "N", 2, 0)[0] == "a1bNc3"
+        assert DICT_FNS["regexpreplace"](np.asarray(["AxA"], dtype=object), "a", "z", 0, -1, "i")[0] == "zxz"

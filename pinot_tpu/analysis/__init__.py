@@ -1,0 +1,43 @@
+"""Static analysis: plan-time checks, repo lint, recompilation audit.
+
+Three cooperating passes that enforce staging-time invariants BEFORE any
+JAX tracing happens (DrJAX-style: MapReduce-shaped JAX programs stay fast
+only when static shapes / stable dtypes / no host sync hold at trace time):
+
+  plan_check     - type/shape/dtype walker over the query IR; malformed
+                   plans raise structured PlanCheckError instead of an
+                   opaque tracer traceback from inside jax.jit.
+  repo_lint      - ast-based lint over the pinot_tpu tree for JAX
+                   anti-patterns (weak-type float literals in kernels,
+                   host<->device sync inside jitted code, jit-in-loop
+                   recompilation, unlocked shared-state RMW in threaded
+                   cluster classes).
+  compile_audit  - fingerprint -> compile-event recorder wrapped around
+                   the kernel caches; counters exported via utils.metrics
+                   and a guard that flags recompilation storms.
+"""
+from pinot_tpu.analysis.compile_audit import (
+    DIST_AUDIT,
+    MSE_AUDIT,
+    SSE_AUDIT,
+    CompileAudit,
+    RecompilationStormError,
+)
+from pinot_tpu.analysis.plan_check import PlanCheckError, PlanIssue, check_plan, collect_issues
+from pinot_tpu.analysis.repo_lint import Finding, lint_paths, lint_source, lint_tree
+
+__all__ = [
+    "PlanCheckError",
+    "PlanIssue",
+    "check_plan",
+    "collect_issues",
+    "Finding",
+    "lint_source",
+    "lint_paths",
+    "lint_tree",
+    "CompileAudit",
+    "RecompilationStormError",
+    "SSE_AUDIT",
+    "DIST_AUDIT",
+    "MSE_AUDIT",
+]

@@ -1,0 +1,297 @@
+"""JAX-aware repo lint: ast pass over the pinot_tpu tree.
+
+Four rules, each targeting an anti-pattern this codebase has actually
+been bitten by (ADVICE r5) or that silently degrades TPU throughput:
+
+  W001 float-literal-in-jit   bare float literal used in arithmetic or a
+                              comparison INSIDE a jitted kernel body —
+                              python floats are weak-typed and promote
+                              int columns to f32 mid-kernel.
+  W002 host-sync-in-jit       .item() / np.asarray / .block_until_ready /
+                              jax.device_get inside a jitted kernel body:
+                              a host<->device sync point inside traced
+                              code either fails to trace or serializes
+                              the async dispatch pipeline.
+  W003 jit-in-loop            jax.jit(...) constructed inside a for/while
+                              body, or jit-then-call in one expression
+                              (jax.jit(f)(x)): a fresh wrapper per
+                              iteration/call defeats the compile cache.
+  W004 unlocked-shared-rmw    read-modify-write of a shared `self.*`
+                              attribute in a cluster/ class method with no
+                              enclosing `with <lock>:` — the exact broker
+                              token-bucket race class from ADVICE r5.
+
+Kernel bodies (W001/W002 scope) are functions the module jits: decorated
+with @jax.jit / @partial(jax.jit, ...) or passed by name to jax.jit(...)
+anywhere in the file.  Closure-jitted lambdas need dataflow analysis and
+are out of scope — the repo convention is named kernels.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set
+
+RULES: Dict[str, str] = {
+    "W001": "float literal in jitted kernel (weak-type f32 promotion)",
+    "W002": "host<->device sync inside jitted kernel",
+    "W003": "jax.jit constructed per-iteration/per-call (recompiles)",
+    "W004": "unlocked read-modify-write of shared state in cluster class",
+}
+
+_HOST_SYNC_ATTRS = frozenset({"item", "block_until_ready", "device_get", "tolist"})
+_HOST_MODULES = frozenset({"np", "numpy"})
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _is_jit_func(node: ast.AST) -> bool:
+    """ast node that refers to jax.jit (Name 'jit' or Attribute '*.jit')."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jitted_function_names(tree: ast.AST) -> Set[str]:
+    """Names passed to jax.jit(...) as a bare Name anywhere in the module."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_func(node.func):
+            for a in node.args[:1]:
+                if isinstance(a, ast.Name):
+                    out.add(a.id)
+    return out
+
+
+def _has_jit_decorator(fn: ast.FunctionDef) -> bool:
+    for d in fn.decorator_list:
+        if _is_jit_func(d):
+            return True
+        if isinstance(d, ast.Call):
+            if _is_jit_func(d.func):
+                return True
+            # @partial(jax.jit, ...)
+            if (
+                isinstance(d.func, ast.Name)
+                and d.func.id == "partial"
+                and d.args
+                and _is_jit_func(d.args[0])
+            ):
+                return True
+    return False
+
+
+def _mentions_lock(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and "lock" in n.attr.lower():
+            return True
+        if isinstance(n, ast.Name) and "lock" in n.id.lower():
+            return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for `self.x`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _reads_self_attr(node: ast.AST, attr: str) -> bool:
+    for n in ast.walk(node):
+        if _self_attr(n) == attr:
+            return True
+    return False
+
+
+class _KernelRules(ast.NodeVisitor):
+    """W001 + W002 inside one jitted kernel body."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(self.path, getattr(node, "lineno", 0), rule, msg))
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        for op in (node.left, node.right):
+            if isinstance(op, ast.Constant) and type(op.value) is float:
+                self._flag("W001", op, f"float literal {op.value!r} in kernel arithmetic")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op in [node.left] + list(node.comparators):
+            if isinstance(op, ast.Constant) and type(op.value) is float:
+                self._flag("W001", op, f"float literal {op.value!r} in kernel comparison")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _HOST_SYNC_ATTRS:
+                self._flag("W002", node, f".{f.attr}() syncs host<->device inside a kernel")
+            elif (
+                f.attr == "asarray"
+                and isinstance(f.value, ast.Name)
+                and f.value.id in _HOST_MODULES
+            ):
+                self._flag("W002", node, f"{f.value.id}.asarray() materializes on host inside a kernel")
+        self.generic_visit(node)
+
+
+def _check_w003(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    loop_depth_of: Dict[int, int] = {}
+
+    def walk(node: ast.AST, depth: int) -> None:
+        is_loop = isinstance(node, (ast.For, ast.While))
+        for child in ast.iter_child_nodes(node):
+            # function/class bodies reset the loop scope: a def inside a
+            # loop compiles when CALLED, not per loop iteration
+            nd = 0 if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)) else depth + (1 if is_loop else 0)
+            if isinstance(child, ast.Call) and _is_jit_func(child.func) and nd > 0:
+                findings.append(
+                    Finding(path, child.lineno, "W003", "jax.jit(...) constructed inside a loop body")
+                )
+            if (
+                isinstance(child, ast.Call)
+                and isinstance(child.func, ast.Call)
+                and _is_jit_func(child.func.func)
+            ):
+                findings.append(
+                    Finding(path, child.lineno, "W003", "jax.jit(f)(...) jit-then-call never caches")
+                )
+            walk(child, nd)
+
+    walk(tree, 0)
+
+
+def _check_w004(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """Unlocked RMW on shared self attributes in cluster/ classes."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef) or fn.name == "__init__":
+                continue
+            # local aliases of self attrs (`b = self._buckets.get(k)` then
+            # `b[0] = ...` is still an RMW on the shared dict's values)
+            aliases: Dict[str, str] = {}
+            locked_lines: List[range] = []
+            for n in ast.walk(fn):
+                if isinstance(n, ast.With) and any(_mentions_lock(i.context_expr) for i in n.items):
+                    locked_lines.append(range(n.lineno, (n.end_lineno or n.lineno) + 1))
+
+            def under_lock(node: ast.AST) -> bool:
+                ln = getattr(node, "lineno", 0)
+                return any(ln in r for r in locked_lines)
+
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+                    src = n.value
+                    if isinstance(src, ast.Call) and isinstance(src.func, ast.Attribute):
+                        src = src.func.value  # self.x.get(...) -> self.x
+                    if isinstance(src, ast.Subscript):
+                        src = src.value
+                    attr = _self_attr(src)
+                    if attr is not None and not under_lock(n):
+                        aliases[n.targets[0].id] = attr
+
+            def shared_target(t: ast.AST) -> Optional[str]:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        return attr
+                    if isinstance(t.value, ast.Name) and t.value.id in aliases:
+                        return aliases[t.value.id]
+                return _self_attr(t)
+
+            for n in ast.walk(fn):
+                if isinstance(n, ast.AugAssign):
+                    attr = shared_target(n.target)
+                    if attr is not None and not under_lock(n):
+                        findings.append(
+                            Finding(
+                                path, n.lineno, "W004",
+                                f"unlocked `self.{attr}` read-modify-write in {cls.name}.{fn.name}",
+                            )
+                        )
+                elif isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        attr = shared_target(t) if isinstance(t, ast.Subscript) else None
+                        if attr is None or under_lock(n):
+                            continue
+                        # writing through an ALIAS of a shared container is an
+                        # RMW by construction (the alias bind read it); direct
+                        # self.X[k] = v writes only count when the value reads
+                        # X back (plain inserts are setup, not RMW)
+                        via_alias = (
+                            isinstance(t.value, ast.Name) and t.value.id in aliases
+                        )
+                        reads = via_alias or _reads_self_attr(n.value, attr) or any(
+                            isinstance(x, ast.Name) and aliases.get(x.id) == attr
+                            for x in ast.walk(n.value)
+                        )
+                        if reads:
+                            findings.append(
+                                Finding(
+                                    path, n.lineno, "W004",
+                                    f"unlocked `self.{attr}` read-modify-write in {cls.name}.{fn.name}",
+                                )
+                            )
+
+
+def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> List[Finding]:
+    """Lint one module's source.  `threaded` enables W004 (cluster/ scope)."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "E000", f"syntax error: {e.msg}")]
+
+    jitted = _jitted_function_names(tree)
+    kernel_rules = _KernelRules(path, findings)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and (node.name in jitted or _has_jit_decorator(node)):
+            for stmt in node.body:
+                kernel_rules.visit(stmt)
+    _check_w003(path, tree, findings)
+    if threaded:
+        _check_w004(path, tree, findings)
+    return findings
+
+
+def lint_paths(paths: Iterable[str], pkg_root: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        rel = os.path.relpath(p, pkg_root) if pkg_root else p
+        threaded = os.sep + "cluster" + os.sep in p or rel.startswith("cluster" + os.sep)
+        with open(p, "r", encoding="utf-8") as f:
+            findings.extend(lint_source(f.read(), path=rel, threaded=threaded))
+    return findings
+
+
+def lint_tree(root: Optional[str] = None) -> List[Finding]:
+    """Lint every .py file under the pinot_tpu package (default: this one)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                paths.append(os.path.join(dirpath, name))
+    return lint_paths(sorted(paths), pkg_root=os.path.dirname(root))

@@ -15,6 +15,7 @@ selection — matches the reference contracts.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -42,23 +43,27 @@ class QueryQuotaManager:
         # table -> [tokens, last_refill_monotonic]
         self._buckets: Dict[str, List[float]] = {}
         self.clock = time.monotonic  # injectable for deterministic tests
+        # the refill/charge sequence is a read-modify-write: concurrent REST
+        # handler threads would over-admit past the bucket (ADVICE r5 race)
+        self._lock = threading.Lock()
 
     def check(self, table: str, max_qps: float, now: Optional[float] = None) -> None:
         if max_qps <= 0:
             return
         t = self.clock() if now is None else now
         cap = max(1.0, float(max_qps))
-        b = self._buckets.get(table)
-        if b is None:
-            b = self._buckets[table] = [cap, t]
-        tokens = min(cap, b[0] + max_qps * (t - b[1]))
-        b[1] = t
-        if tokens < 1.0:
-            b[0] = tokens
-            raise QuotaExceededError(
-                f"table {table!r} exceeded maxQueriesPerSecond={max_qps:g}"
-            )
-        b[0] = tokens - 1.0
+        with self._lock:
+            b = self._buckets.get(table)
+            if b is None:
+                b = self._buckets[table] = [cap, t]
+            tokens = min(cap, b[0] + max_qps * (t - b[1]))
+            b[1] = t
+            if tokens < 1.0:
+                b[0] = tokens
+                raise QuotaExceededError(
+                    f"table {table!r} exceeded maxQueriesPerSecond={max_qps:g}"
+                )
+            b[0] = tokens - 1.0
 
 
 class AdaptiveServerStats:
@@ -72,16 +77,22 @@ class AdaptiveServerStats:
     def __init__(self) -> None:
         self.ewma_ms: Dict[str, float] = {}
         self.in_flight: Dict[str, int] = {}
+        # begin/end race from concurrent scatter threads: unlocked, two
+        # begins could both read in_flight=0 and a decay update could be
+        # lost entirely (ADVICE r5 race class)
+        self._lock = threading.Lock()
 
     def begin(self, server: str) -> None:
-        self.in_flight[server] = self.in_flight.get(server, 0) + 1
+        with self._lock:
+            self.in_flight[server] = self.in_flight.get(server, 0) + 1
 
     def end(self, server: str, latency_ms: float) -> None:
-        self.in_flight[server] = max(0, self.in_flight.get(server, 1) - 1)
-        prev = self.ewma_ms.get(server)
-        self.ewma_ms[server] = (
-            latency_ms if prev is None else prev + self.ALPHA * (latency_ms - prev)
-        )
+        with self._lock:
+            self.in_flight[server] = max(0, self.in_flight.get(server, 1) - 1)
+            prev = self.ewma_ms.get(server)
+            self.ewma_ms[server] = (
+                latency_ms if prev is None else prev + self.ALPHA * (latency_ms - prev)
+            )
 
     def score(self, server: str) -> float:
         # unseen servers score best (explore), matching the reference's
@@ -95,6 +106,7 @@ class Broker:
         self.coordinator = coordinator
         self.selector = selector  # "balanced" | "replicagroup" | "adaptive"
         self._rr = 0  # round-robin cursor
+        self._rr_lock = threading.Lock()  # cursor bump is an RMW across handler threads
         self.quota = QueryQuotaManager()
         self.server_stats = AdaptiveServerStats()
 
@@ -103,7 +115,8 @@ class Broker:
         """segment list -> {server: [segments]} picking ONE live replica per
         segment (InstanceSelector contract)."""
         view = self.coordinator.external_view(table)
-        self._rr += 1
+        with self._rr_lock:
+            self._rr += 1
         if self.selector == "replicagroup":
             # strict replica-group: pick ONE group serving ALL segments
             groups: Dict[int, Set[str]] = {}
@@ -207,6 +220,12 @@ class Broker:
         table = ctx.table
         if table not in self.coordinator.tables:
             raise KeyError(f"table {table!r} not found")
+        # schema-aware static validation before scatter: a malformed plan
+        # fails ONCE at the broker with a structured error instead of
+        # failing per-server inside jit tracing
+        from pinot_tpu.analysis.plan_check import check_plan
+
+        check_plan(ctx, self.coordinator.tables[table].schema)
         self._inject_global_ranges(ctx, table)
         # hybrid tables (offline segments + a realtime manager under ONE
         # name): a TIME BOUNDARY splits the parts — offline answers

@@ -12,6 +12,7 @@ Re-design: ideal state / external view are plain dicts owned by this object
 """
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -43,12 +44,16 @@ class Coordinator:
         # replica-group membership: server -> group id (round-robin on join)
         self.replica_group: Dict[str, int] = {}
         self.num_replica_groups = max(1, replication)
+        # group assignment reads len(replica_group) then writes it: two
+        # servers joining concurrently would land in the same group
+        self._membership_lock = threading.Lock()
 
     # -- instance lifecycle (Helix participant analog) -------------------
     def register_server(self, server) -> None:
-        self.servers[server.name] = server
-        self.live.add(server.name)
-        self.replica_group[server.name] = len(self.replica_group) % self.num_replica_groups
+        with self._membership_lock:
+            self.servers[server.name] = server
+            self.live.add(server.name)
+            self.replica_group[server.name] = len(self.replica_group) % self.num_replica_groups
 
     def mark_down(self, name: str) -> None:
         """Liveness loss (Helix session expiry analog): external view drops

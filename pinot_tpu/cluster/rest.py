@@ -111,12 +111,17 @@ class QueryServer:
                         ]
                     self._send(200, payload)
                 except Exception as e:  # noqa: BLE001 - boundary
+                    from pinot_tpu.analysis.plan_check import PlanCheckError
                     from pinot_tpu.cluster.broker import QuotaExceededError
 
                     if isinstance(e, QuotaExceededError):
                         # the reference's 429 QUERY_QUOTA_EXCEEDED contract:
                         # throttled clients must be able to back off
                         self._send(429, {"error": str(e), "errorCode": "QUERY_QUOTA_EXCEEDED"})
+                    elif isinstance(e, PlanCheckError):
+                        # statically-rejected plan: a 400 with the machine
+                        # code, never a tracer traceback (analysis/plan_check)
+                        self._send(400, e.to_dict())
                     else:
                         self._send(500, {"error": f"{type(e).__name__}: {e}"})
 

@@ -228,6 +228,8 @@ class MultiStageEngine:
 
     # ------------------------------------------------------------------
     def _plan(self, ctx: QueryContext) -> _MsePlan:
+        from pinot_tpu.analysis.compile_audit import MSE_AUDIT
+
         rq = resolve(ctx, self.tables)
         strategy = self._strategy(ctx, rq)
         key = (
@@ -239,7 +241,9 @@ class MultiStageEngine:
         )
         cached = self._plan_cache.get(key)
         if cached is not None:
+            MSE_AUDIT.record_hit(key[0])
             return cached
+        MSE_AUDIT.record_compile(key[0])
         plan = self._build_plan(rq, strategy)
         self._plan_cache[key] = plan
         return plan

@@ -61,6 +61,12 @@ class ResolvedQuery:
 
 def resolve(ctx: QueryContext, schemas: Dict[str, "object"]) -> ResolvedQuery:
     """schemas: table name -> object with .column_names (Schema/StackedTable)."""
+    # schema-free static validation (function existence/arity, agg nesting,
+    # limit sanity) before join resolution; column ownership is checked by
+    # resolve_name below against the per-table column sets
+    from pinot_tpu.analysis.plan_check import check_plan
+
+    check_plan(ctx)
     fact = ctx.table
     if fact not in schemas:
         raise JoinPlanError(f"table {fact!r} is not registered")

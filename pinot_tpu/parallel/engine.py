@@ -265,13 +265,19 @@ class DistributedEngine:
 
     # ------------------------------------------------------------------
     def _plan(self, ctx: QueryContext, stacked) -> _DistPlan:
+        from pinot_tpu.analysis.compile_audit import DIST_AUDIT
+        from pinot_tpu.analysis.plan_check import check_plan_cached
+
+        check_plan_cached(ctx)
         batch_docs, batch_offsets = self._batching(ctx, stacked)
         key = (
             ctx.fingerprint(), stacked.signature(), self.axis, self.num_devices, batch_docs,
         )
         cached = self._plan_cache.get(key)
         if cached is not None:
+            DIST_AUDIT.record_hit(key[0])
             return cached
+        DIST_AUDIT.record_compile(key[0])
         plan = self._build_plan(ctx, stacked, batch_docs, batch_offsets)
         self._plan_cache[key] = plan
         return plan

@@ -205,15 +205,11 @@ class DistinctCountThetaFunction(AggFunction):
         import jax.numpy as jnp
         from jax import lax
 
-        from pinot_tpu.query.sketches import _device_hash32, _device_hash_values
+        from pinot_tpu.query.sketches import _device_hash62
 
-        h1 = _device_hash_values(values)
-        h2 = _device_hash32(h1 ^ np.uint32(0x9E3779B9))
-        # clean 62-bit hash in [0, 2^62): h1 -> bits 31..61, h2 -> bits 0..30
+        # clean 62-bit hash in [0, 2^62): two independently seeded streams
         # (positive int64, so int64 sort order == unsigned order)
-        h = ((h1 & np.uint32(0x7FFFFFFF)).astype(jnp.int64) << np.int64(31)) | (
-            h2 >> np.uint32(1)
-        ).astype(jnp.int64)
+        h = _device_hash62(values)
         h = jnp.where(mask, h, _I64_MAX)
         s = lax.sort(h)
         prev = jnp.concatenate([jnp.full((1,), -1, s.dtype), s[:-1]])
@@ -237,17 +233,13 @@ class DistinctCountThetaFunction(AggFunction):
         import jax.numpy as jnp
         from jax import lax
 
-        from pinot_tpu.query.sketches import _device_hash32, _device_hash_values
+        from pinot_tpu.query.sketches import _device_hash62
 
         if self.filter_exprs:
             raise NotImplementedError("theta sub-filter set expressions do not support GROUP BY")
         kk = max(16, min(self.GROUPED_K, 2_000_000 // max(1, num_groups)))
         _check_cell_budget(self.name, num_groups, kk)
-        h1 = _device_hash_values(values)
-        h2 = _device_hash32(h1 ^ np.uint32(0x9E3779B9))
-        h = ((h1 & np.uint32(0x7FFFFFFF)).astype(jnp.int64) << np.int64(31)) | (
-            h2 >> np.uint32(1)
-        ).astype(jnp.int64)
+        h = _device_hash62(values)
         gk = jnp.where(mask, keys.astype(jnp.int32), np.int32(num_groups))
         h = jnp.where(mask, h, _I64_MAX)
         s_k, s_h = lax.sort((gk, h), num_keys=2)

@@ -364,15 +364,9 @@ class IntegerTupleSketchFunction(AggFunction):
         return self  # hash-based
 
     def _hash(self, values):
-        import jax.numpy as jnp
+        from pinot_tpu.query.sketches import _device_hash62
 
-        from pinot_tpu.query.sketches import _device_hash32, _device_hash_values
-
-        h1 = _device_hash_values(values)
-        h2 = _device_hash32(h1 ^ np.uint32(0x9E3779B9))
-        return ((h1 & np.uint32(0x7FFFFFFF)).astype(jnp.int64) << np.int64(31)) | (
-            h2 >> np.uint32(1)
-        ).astype(jnp.int64)
+        return _device_hash62(values)
 
     def partial(self, values, mask):
         return {k: t[0] for k, t in self.partial_grouped(values, mask, None, 1).items()}

@@ -85,6 +85,11 @@ class QueryEngine:
         trace = Trace(bool(ctx.options.get("trace", False)))
         METRICS.counter("queries").inc()
         state = self.table(ctx.table)
+        # schema-aware static validation before any per-segment planning:
+        # malformed plans fail here with a structured PlanCheckError
+        from pinot_tpu.analysis.plan_check import check_plan
+
+        check_plan(ctx, state.schema)
         segments = state.query_segments()
         self._inject_global_ranges(ctx, state, segments)
         # admission: charge the estimated device bytes up front (safety.py),

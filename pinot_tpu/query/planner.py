@@ -864,6 +864,12 @@ def sparse_grouped_tables(aggs, inputs, tmask, key, num_slots: int, order_spec=N
 
 
 def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
+    from pinot_tpu.analysis.compile_audit import SSE_AUDIT
+    from pinot_tpu.analysis.plan_check import check_plan_cached
+
+    # static IR validation before anything traces: malformed plans raise
+    # structured PlanCheckError here instead of a tracer error inside jit
+    check_plan_cached(ctx)
     needed = _needed_columns(ctx, segment)
     key = (
         ctx.fingerprint(),
@@ -872,8 +878,10 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     cached = _PLAN_CACHE.get(key)
     if cached is not None:
         # params are per-segment (dictionary-dependent): rebuild them, reuse fn
+        SSE_AUDIT.record_hit(key[0])
         plan = _build_plan(ctx, segment, needed, compiled_fn=cached.fn)
         return plan
+    SSE_AUDIT.record_compile(key[0])
     plan = _build_plan(ctx, segment, needed, compiled_fn=None)
     _PLAN_CACHE[key] = plan
     return plan

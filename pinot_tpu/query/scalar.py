@@ -312,8 +312,10 @@ _TZ_YEARS = (1970, 2080)
 def _tz_table(tz_name: str):
     """(transition_ms int64[n], offset_ms int64[n]): offset_ms[i] is the
     zone's UTC offset from transition_ms[i] (until the next entry).  Built
-    by ~monthly probing with bisection to 1-minute precision (zoneinfo
-    exposes no transition list; real transitions are >1 month apart)."""
+    by ~monthly probing with bisection to 1 ms precision (zoneinfo exposes
+    no transition list; real transitions are >1 month apart) — the old
+    1-minute tolerance misplaced instants within a minute of a DST shift
+    (ADVICE r5)."""
     import datetime as _dt
 
     try:
@@ -341,7 +343,7 @@ def _tz_table(tz_name: str):
         o = off(nt)
         if o != offs[-1]:
             lo, hi = t, nt
-            while hi - lo > 60_000:
+            while hi - lo > 1:
                 mid = (lo + hi) // 2
                 if off(mid) == offs[-1]:
                     lo = mid

@@ -280,7 +280,7 @@ def _device_hash32(x):
     return h
 
 
-def _device_hash_values(v):
+def _device_hash_values(v, seed=np.uint32(0)):
     """Hash arbitrary-width numeric values with 32-bit ops only.
 
     8-byte types split into two 32-bit words so (nearly) the full bit
@@ -288,10 +288,17 @@ def _device_hash_values(v):
     2^32 apart (review-caught).  TPU's X64 rewriter cannot lower 64-bit
     bitcast-convert, so the split is arithmetic: LONGs shift/mask; DOUBLEs
     take the float32 head + float32 residual (~48 mantissa bits; doubles
-    closer than that collide, which is within HLL's approximation budget)."""
+    closer than that collide, which is within HLL's approximation budget).
+
+    `seed` XORs into the input lanes before finalizing, yielding an
+    INDEPENDENT hash stream per seed — the 62-bit sketch hashes combine two
+    differently-seeded streams of the full value instead of deriving the low
+    word from the high one (ADVICE r5: hash32(h1^c) carries only h1's 32
+    bits of entropy)."""
     import jax.numpy as jnp
     from jax import lax
 
+    seed = np.uint32(seed)
     if v.dtype.itemsize == 8:
         if jnp.issubdtype(v.dtype, jnp.floating):
             head = v.astype(jnp.float32)
@@ -301,10 +308,28 @@ def _device_hash_values(v):
         else:
             w0 = (v & np.int64(0xFFFFFFFF)).astype(jnp.uint32)
             w1 = ((v >> np.int64(32)) & np.int64(0xFFFFFFFF)).astype(jnp.uint32)
-        return _device_hash32(w0 ^ _device_hash32(w1))
+        return _device_hash32((w0 ^ seed) ^ _device_hash32(w1 ^ seed))
     if jnp.issubdtype(v.dtype, jnp.floating):
-        return _device_hash32(lax.bitcast_convert_type(v.astype(jnp.float32), jnp.uint32))
-    return _device_hash32(v.astype(jnp.int32))
+        return _device_hash32(lax.bitcast_convert_type(v.astype(jnp.float32), jnp.uint32) ^ seed)
+    return _device_hash32(v.astype(jnp.int32).astype(jnp.uint32) ^ seed)
+
+
+# second-stream seed for the 62-bit KMV hashes (any odd constant works; this
+# is the golden-ratio word the old derived construction reused as an XOR)
+_H2_SEED = np.uint32(0x9E3779B9)
+
+
+def _device_hash62(values):
+    """Positive-int64 62-bit hash: two independently seeded 32-bit streams,
+    h1 -> bits 31..61, h2 -> bits 0..30 (int64 sort order == unsigned order).
+    Shared by the theta/tuple KMV sketches."""
+    import jax.numpy as jnp
+
+    h1 = _device_hash_values(values)
+    h2 = _device_hash_values(values, seed=_H2_SEED)
+    return ((h1 & np.uint32(0x7FFFFFFF)).astype(jnp.int64) << np.int64(31)) | (
+        h2 >> np.uint32(1)
+    ).astype(jnp.int64)
 
 
 class DistinctCountHLLFunction(AggFunction):

@@ -7,6 +7,7 @@ PostQuery, StartServiceManager/quickstart commands).
   python -m pinot_tpu.tools.cli query --segments dir1 dir2 --sql "SELECT ..."
   python -m pinot_tpu.tools.cli serve --segments dir1 --port 8099
   python -m pinot_tpu.tools.cli quickstart
+  python -m pinot_tpu.tools.cli lint [paths...]
 """
 from __future__ import annotations
 
@@ -126,6 +127,26 @@ def cmd_quickstart(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """JAX-aware static lint (analysis/repo_lint.py) over the package tree
+    or explicit paths; exit 1 when findings exist so CI can gate on it."""
+    from pinot_tpu.analysis.repo_lint import RULES, lint_paths, lint_tree
+
+    if args.paths:
+        findings = lint_paths(args.paths)
+    else:
+        findings = lint_tree()
+    for f in findings:
+        print(f)
+    if findings and args.explain:
+        print("\nrules:", file=sys.stderr)
+        hit = {f.rule for f in findings}
+        for rule in sorted(hit):
+            print(f"  {rule}: {RULES.get(rule, '?')}", file=sys.stderr)
+    print(f"{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="pinot_tpu", description="pinot_tpu admin CLI")
     sub = p.add_subparsers(dest="command", required=True)
@@ -151,6 +172,11 @@ def main(argv=None) -> int:
 
     qs = sub.add_parser("quickstart", help="in-memory demo table + example queries")
     qs.set_defaults(fn=cmd_quickstart)
+
+    lt = sub.add_parser("lint", help="JAX-aware static lint over the pinot_tpu tree")
+    lt.add_argument("paths", nargs="*", help="python files to lint (default: the installed package)")
+    lt.add_argument("--explain", action="store_true", help="print rule descriptions for findings")
+    lt.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,26 @@
+"""Tier-1 CI gate: `pinot_tpu lint` must exit clean on the shipped tree.
+
+Kept as its own tiny module so the gate shows up as one named test in the
+standard tier-1 run (ROADMAP command unchanged)."""
+import pinot_tpu.tools.cli as cli
+
+
+def test_cli_lint_exits_zero(capsys):
+    rc = cli.main(["lint"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+    assert "0 finding(s)" in out.err
+
+
+def test_cli_lint_flags_bad_path(tmp_path, capsys):
+    bad = tmp_path / "cluster" / "racy.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "class C:\n"
+        "    def bump(self):\n"
+        "        self._n += 1\n"
+    )
+    rc = cli.main(["lint", str(bad), "--explain"])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "W004" in out.out and "1 finding(s)" in out.err

@@ -1,0 +1,152 @@
+"""Plan-time static checker (pinot_tpu.analysis.plan_check).
+
+Every malformed-plan class must be rejected BEFORE the planner traces into
+jax.jit, with a stable machine code; every plan the executors accepted
+before the checker existed must still pass."""
+import numpy as np
+import pytest
+
+from pinot_tpu.analysis.plan_check import (
+    PlanCheckError,
+    check_plan,
+    collect_issues,
+)
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.query.ir import AggregationSpec, Expr, QueryContext
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+N = 500
+
+
+@pytest.fixture(scope="module")
+def eng():
+    rng = np.random.default_rng(11)
+    schema = Schema(
+        "demo",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("amount", DataType.DOUBLE, role=FieldRole.METRIC),
+            FieldSpec("n", DataType.INT, role=FieldRole.METRIC),
+            FieldSpec("big", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+    e = QueryEngine()
+    e.register_table(schema)
+    data = {
+        "city": rng.choice(["sf", "nyc", "tokyo"], N).astype(object),
+        "amount": np.round(rng.random(N) * 100, 2),
+        "n": rng.integers(0, 100, N).astype(np.int32),
+        "big": rng.integers(0, 1 << 40, N),
+        "ts": 1_700_000_000_000 + rng.integers(0, 30 * 86_400_000, N),
+    }
+    e.add_segment("demo", build_segment(schema, data, "demo_0"))
+    return e
+
+
+GOOD = [
+    "SELECT COUNT(*) FROM demo",
+    "SELECT city, SUM(amount) FROM demo GROUP BY city ORDER BY SUM(amount) DESC",
+    "SELECT MAX(amount) - MIN(amount) FROM demo",
+    "SELECT DATETRUNC('day', ts), COUNT(*) FROM demo GROUP BY DATETRUNC('day', ts)",
+    "SELECT city, SUM(n) FROM demo GROUP BY city HAVING SUM(n) > 10",
+    "SELECT DISTINCTCOUNTHLL(city) FROM demo",
+    "SELECT city AS c, COUNT(*) FROM demo GROUP BY city ORDER BY c",
+    "SELECT PERCENTILE(amount, 95) FROM demo",
+    "SELECT SUM(amount) FROM demo WHERE n BETWEEN 5 AND 50",
+]
+
+
+@pytest.mark.parametrize("sql", GOOD)
+def test_valid_plans_pass(eng, sql):
+    res = eng.sql(sql)
+    assert res.rows is not None
+
+
+# (sql, expected machine code) — each a DISTINCT malformed-plan class
+BAD = [
+    ("SELECT FROBNICATE(amount) FROM demo", "UNKNOWN_FUNCTION"),
+    ("SELECT SUM(MAX(amount)) FROM demo", "NESTED_AGGREGATION"),
+    ("SELECT city FROM demo WHERE SUM(amount) > 10", "NESTED_AGGREGATION"),
+    ("SELECT POWER(n) FROM demo", "BAD_ARITY"),
+    ("SELECT COUNT(*) FROM demo WHERE n = 'abc'", "TYPE_MISMATCH"),
+    ("SELECT COUNT(*) FROM demo WHERE REGEXP_LIKE(n, 'a.*')", "TYPE_MISMATCH"),
+    ("SELECT COUNT(*) FROM demo WHERE n = 99999999999", "INT32_OVERFLOW"),
+    ("SELECT nosuchcol FROM demo", "UNKNOWN_COLUMN"),
+    ("SELECT COUNT(*) FROM demo WHERE n = 1.5", "WEAK_TYPE_PROMOTION"),
+    ("SELECT city, COUNT(*) FROM demo GROUP BY city ORDER BY amount", "BAD_ORDER_BY"),
+]
+
+
+@pytest.mark.parametrize("sql,code", BAD, ids=[c for _, c in BAD])
+def test_malformed_plans_rejected(eng, sql, code):
+    with pytest.raises(PlanCheckError) as ei:
+        eng.sql(sql)
+    assert ei.value.code == code
+    d = ei.value.to_dict()
+    assert d["errorCode"] == code and d["error"]
+
+
+def test_ungroupable_literal_key():
+    # the parser never emits literal group keys; direct IR can
+    ctx = QueryContext(
+        table="demo",
+        select_list=[AggregationSpec(function="count", expr=None)],
+        group_by=[Expr.lit(7)],
+    )
+    with pytest.raises(PlanCheckError) as ei:
+        check_plan(ctx)
+    assert ei.value.code == "UNGROUPABLE_KEY"
+
+
+def test_bad_limit_and_offset():
+    ctx = QueryContext(table="demo", select_list=[Expr.col("city")], limit=-1)
+    with pytest.raises(PlanCheckError) as ei:
+        check_plan(ctx)
+    assert ei.value.code == "BAD_LIMIT"
+    ctx = QueryContext(table="demo", select_list=[Expr.col("city")], offset=-5)
+    issues = collect_issues(ctx)
+    assert [i.code for i in issues] == ["BAD_LIMIT"]
+
+
+def test_plan_check_error_is_valueerror():
+    # pre-existing callers catch ValueError; the checker must not change that
+    assert issubclass(PlanCheckError, ValueError)
+
+
+def test_collect_issues_reports_all_defects():
+    ctx = QueryContext(
+        table="demo",
+        select_list=[Expr.call("frobnicate", Expr.col("city"))],
+        group_by=[Expr.lit(1)],
+        limit=-2,
+    )
+    codes = {i.code for i in collect_issues(ctx)}
+    assert {"UNKNOWN_FUNCTION", "UNGROUPABLE_KEY", "BAD_LIMIT"} <= codes
+
+
+def test_rest_surface_maps_to_structured_400(eng):
+    """A statically-rejected plan must surface to HTTP clients as a 400 with
+    the machine code — never a 500 tracer traceback."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from pinot_tpu.cluster.rest import QueryServer
+
+    server = QueryServer(eng).start()
+    try:
+        body = json.dumps({"sql": "SELECT SUM(MAX(amount)) FROM demo"}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/query/sql",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+        payload = json.loads(ei.value.read().decode())
+        assert payload["errorCode"] == "NESTED_AGGREGATION"
+    finally:
+        server.stop()

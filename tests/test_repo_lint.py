@@ -1,0 +1,178 @@
+"""JAX-aware repo lint (pinot_tpu.analysis.repo_lint).
+
+Each rule fires on a minimal fixture snippet and stays quiet on the
+locked/hoisted counterpart; the live pinot_tpu tree must be clean."""
+import textwrap
+
+from pinot_tpu.analysis.repo_lint import Finding, lint_source, lint_tree
+
+
+def _lint(src, threaded=False):
+    return lint_source(textwrap.dedent(src), path="fixture.py", threaded=threaded)
+
+
+def _rules(src, threaded=False):
+    return [f.rule for f in _lint(src, threaded=threaded)]
+
+
+class TestW001FloatLiteralInKernel:
+    def test_flags_float_literal_in_jitted_arithmetic(self):
+        src = """
+        import jax
+
+        def kernel(x):
+            return x * 0.5
+
+        fn = jax.jit(kernel)
+        """
+        assert _rules(src) == ["W001"]
+
+    def test_flags_float_comparison_under_decorator(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return x > 1.5
+        """
+        assert _rules(src) == ["W001"]
+
+    def test_quiet_outside_kernels_and_on_int_literals(self):
+        src = """
+        import jax
+
+        def helper(x):
+            return x * 0.5  # not jitted: host-side is fine
+
+        def kernel(x):
+            return x * 2
+
+        fn = jax.jit(kernel)
+        """
+        assert _rules(src) == []
+
+
+class TestW002HostSyncInKernel:
+    def test_flags_item_and_np_asarray(self):
+        src = """
+        import jax
+        import numpy as np
+
+        def kernel(x):
+            n = x.sum().item()
+            return np.asarray(x) + n
+
+        fn = jax.jit(kernel)
+        """
+        assert _rules(src) == ["W002", "W002"]
+
+    def test_quiet_on_jnp_asarray(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        def kernel(x):
+            return jnp.asarray(x)
+
+        fn = jax.jit(kernel)
+        """
+        assert _rules(src) == []
+
+
+class TestW003JitInLoop:
+    def test_flags_jit_inside_loop_body(self):
+        src = """
+        import jax
+
+        def run(fns, x):
+            outs = []
+            for f in fns:
+                outs.append(jax.jit(f)(x))
+            return outs
+        """
+        assert "W003" in _rules(src)
+
+    def test_quiet_when_hoisted(self):
+        src = """
+        import jax
+
+        def run(f, xs):
+            g = jax.jit(f)
+            return [g(x) for x in xs]
+        """
+        assert _rules(src) == []
+
+    def test_def_inside_loop_resets_scope(self):
+        src = """
+        import jax
+
+        for name in ("a", "b"):
+            def make(f):
+                return jax.jit(f)
+        """
+        assert _rules(src) == []
+
+
+class TestW004UnlockedSharedRMW:
+    def test_flags_augassign_on_self_attr(self):
+        src = """
+        class Broker:
+            def route(self):
+                self._rr += 1
+        """
+        assert _rules(src, threaded=True) == ["W004"]
+
+    def test_flags_alias_bucket_write(self):
+        # the exact broker token-bucket race shape from ADVICE r5
+        src = """
+        class Quota:
+            def check(self, table):
+                b = self._buckets.get(table)
+                b[0] = b[0] - 1
+        """
+        assert _rules(src, threaded=True) == ["W004"]
+
+    def test_quiet_under_lock(self):
+        src = """
+        class Broker:
+            def route(self):
+                with self._lock:
+                    self._rr += 1
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_quiet_on_plain_insert_and_init(self):
+        src = """
+        class Broker:
+            def __init__(self):
+                self._rr = 0
+
+            def register(self, name, server):
+                self.servers[name] = server
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_w004_requires_threaded_scope(self):
+        src = """
+        class Planner:
+            def bump(self):
+                self._n += 1
+        """
+        assert _rules(src, threaded=False) == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    out = lint_source("def broken(:\n", path="x.py")
+    assert len(out) == 1 and out[0].rule == "E000"
+
+
+def test_finding_str_is_greppable():
+    f = Finding("a/b.py", 12, "W001", "msg")
+    assert str(f) == "a/b.py:12: W001 msg"
+
+
+def test_live_tree_is_clean():
+    """The shipped package must lint clean — this is the CI gate that keeps
+    the broker-race class of bug from regressing."""
+    findings = lint_tree()
+    assert findings == [], "\n".join(str(f) for f in findings)

@@ -1,0 +1,47 @@
+"""DST transition precision (ADVICE r5): _tz_table bisects to 1 ms, so an
+instant 30 s before a shift lands in the PRE-shift offset and the boundary
+instant itself in the POST-shift offset — the old 1-minute bisection could
+misclassify up to a minute around each transition."""
+import datetime as dt
+from zoneinfo import ZoneInfo
+
+import numpy as np
+
+from pinot_tpu.query.scalar import _tz_offset_ms, _tz_table
+
+NY = "America/New_York"
+# 2024-03-10 07:00:00 UTC: America/New_York springs forward (EST -> EDT)
+SPRING = 1_710_054_000_000
+# 2024-11-03 06:00:00 UTC: falls back (EDT -> EST)
+FALL = 1_730_613_600_000
+H = 3_600_000
+
+
+def test_table_records_exact_transition_instants():
+    trans, offs = _tz_table(NY)
+    assert SPRING in trans.tolist()
+    assert FALL in trans.tolist()
+
+
+def test_offset_flips_exactly_at_boundary():
+    for boundary, before_off, after_off in (
+        (SPRING, -5 * H, -4 * H),
+        (FALL, -4 * H, -5 * H),
+    ):
+        ms = np.asarray(
+            [boundary - 30_000, boundary - 1, boundary, boundary + 30_000], np.int64
+        )
+        got = np.asarray(_tz_offset_ms(ms, NY))
+        assert got.tolist() == [before_off, before_off, after_off, after_off]
+
+
+def test_thirty_seconds_before_shift_matches_zoneinfo():
+    """Regression: 01:59:30 EST on the spring-forward morning must report
+    the EST offset (the 60 s-precision table could flip it an hour early)."""
+    z = ZoneInfo(NY)
+    for instant in (SPRING - 30_000, FALL - 30_000):
+        want = int(
+            dt.datetime.fromtimestamp(instant / 1000, tz=z).utcoffset().total_seconds() * 1000
+        )
+        got = int(np.asarray(_tz_offset_ms(np.int64(instant), NY)))
+        assert got == want

@@ -67,6 +67,7 @@ def main() -> None:
     import jax.numpy as jnp
     from jax import lax
 
+    from pinot_tpu import ops
     from pinot_tpu.parallel.engine import DistributedEngine
     from pinot_tpu.parallel.stacked import StackedTable
     from pinot_tpu.spi.config import IndexingConfig, TableConfig
@@ -202,6 +203,19 @@ def main() -> None:
         (max(slopes) - min(slopes)) / float(np.median(slopes)) if slopes else -1.0
     )
 
+    # Effective scan bandwidth: bytes the kernel actually streams per row —
+    # packed storage widths of the columns the plan touches (dict codes at
+    # their stored width, not widened), null bitmaps at 1 byte/row, plus one
+    # uint32 per 32 rows for each row-sharded index-bitmap param.
+    bytes_per_row = 0.0
+    for name in plan.needed_columns:
+        c = stacked.column(name)
+        arr = c.codes if c.codes is not None else c.values
+        bytes_per_row += np.asarray(arr).dtype.itemsize
+        if c.nulls is not None:
+            bytes_per_row += 1
+    bytes_per_row += len(plan.row_sharded_params) * 4 / 32
+
     print(
         json.dumps(
             {
@@ -221,6 +235,8 @@ def main() -> None:
                 "filter_index_uses": index_uses,
                 "cpu_proxy_rows_per_sec": round(_cpu_proxy(), 1),
                 "baseline_denominator": JAVA_SERVER_ROWS_PER_SEC,
+                "backend": ops.scan_backend(),
+                "effective_bytes_per_sec": round(rows_per_sec * bytes_per_row, 1),
             }
         )
     )

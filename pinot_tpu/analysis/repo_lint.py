@@ -25,6 +25,14 @@ Kernel bodies (W001/W002 scope) are functions the module jits: decorated
 with @jax.jit / @partial(jax.jit, ...) or passed by name to jax.jit(...)
 anywhere in the file.  Closure-jitted lambdas need dataflow analysis and
 are out of scope — the repo convention is named kernels.
+
+W002 additionally covers two Pallas-era shapes (ops/pallas_scan.py):
+  * ANY `np.`/`numpy.` call inside a Pallas kernel body (a function passed
+    by name to `pl.pallas_call(...)`) — Pallas kernels trace refs; a host
+    numpy call there either fails to trace or silently constant-folds.
+  * `.block_until_ready()` inside a for/while body — a per-launch fence
+    serializes the double-buffered macro-batch pipeline
+    (parallel/engine.py drains with one device_get instead).
 """
 from __future__ import annotations
 
@@ -89,6 +97,85 @@ def _has_jit_decorator(fn: ast.FunctionDef) -> bool:
             ):
                 return True
     return False
+
+
+def _is_pallas_call(node: ast.AST) -> bool:
+    """ast node referring to pallas_call (pl.pallas_call / bare name)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "pallas_call"
+    return isinstance(node, ast.Name) and node.id == "pallas_call"
+
+
+def _pallas_kernel_names(tree: ast.AST) -> Set[str]:
+    """Names passed to pallas_call(...) as a bare Name anywhere in the
+    module — the same by-name convention as _jitted_function_names."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_pallas_call(node.func):
+            for a in node.args[:1]:
+                if isinstance(a, ast.Name):
+                    out.add(a.id)
+    return out
+
+
+class _PallasKernelRules(ast.NodeVisitor):
+    """W002 inside one Pallas kernel body: any host numpy call.
+
+    Stricter than the jit-kernel rule (which allows np scalars like
+    np.int32(0) as weak-type anchors): a Pallas kernel body manipulates
+    Refs, where every np.* call is at best a silent constant fold and at
+    worst a trace error — jnp/lax are the only legal vocabularies."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id in _HOST_MODULES
+        ):
+            self.findings.append(
+                Finding(
+                    self.path, node.lineno, "W002",
+                    f"{f.value.id}.{f.attr}() is a host numpy call inside a Pallas kernel body",
+                )
+            )
+        self.generic_visit(node)
+
+
+def _check_sync_in_loop(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """W002: .block_until_ready() inside a for/while body — a per-launch
+    fence serializes the macro-batch dispatch pipeline (the double-buffer
+    loop must drain via device_get of the oldest launch instead).  Function
+    bodies reset the loop scope, same as W003: a def inside a loop runs
+    when called, not per iteration."""
+
+    def walk(node: ast.AST, depth: int) -> None:
+        is_loop = isinstance(node, (ast.For, ast.While))
+        for child in ast.iter_child_nodes(node):
+            nd = (
+                0
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef))
+                else depth + (1 if is_loop else 0)
+            )
+            if (
+                nd > 0
+                and isinstance(child, ast.Call)
+                and isinstance(child.func, ast.Attribute)
+                and child.func.attr == "block_until_ready"
+            ):
+                findings.append(
+                    Finding(
+                        path, child.lineno, "W002",
+                        "per-launch .block_until_ready() in a loop serializes the dispatch pipeline",
+                    )
+                )
+            walk(child, nd)
+
+    walk(tree, 0)
 
 
 def _mentions_lock(node: ast.AST) -> bool:
@@ -264,12 +351,18 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
         return [Finding(path, e.lineno or 0, "E000", f"syntax error: {e.msg}")]
 
     jitted = _jitted_function_names(tree)
+    pallas = _pallas_kernel_names(tree)
     kernel_rules = _KernelRules(path, findings)
+    pallas_rules = _PallasKernelRules(path, findings)
     for node in ast.walk(tree):
         if isinstance(node, ast.FunctionDef) and (node.name in jitted or _has_jit_decorator(node)):
             for stmt in node.body:
                 kernel_rules.visit(stmt)
+        if isinstance(node, ast.FunctionDef) and node.name in pallas:
+            for stmt in node.body:
+                pallas_rules.visit(stmt)
     _check_w003(path, tree, findings)
+    _check_sync_in_loop(path, tree, findings)
     if threaded:
         _check_w004(path, tree, findings)
     return findings

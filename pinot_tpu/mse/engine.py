@@ -23,8 +23,9 @@ Scope: star joins — FROM fact JOIN dim ON fact.fk = dim.pk — INNER/LEFT,
 aggregation or group-by on fact and/or dim attributes; build sides may have
 NON-unique keys up to a bounded multiplicity (range_join expansion,
 joinMaxDup, broadcast strategy, at most one such join per query).
-Snowflake chains, join-output selection, and cross-table predicates raise
-JoinPlanError/NotImplementedError.
+Snowflake chains (fact→dim→dim) and join-output selection of dimension
+attributes are supported.  Cross-table predicates (WHERE mixing columns of
+both sides outside the ON clause) raise JoinPlanError/NotImplementedError.
 """
 from __future__ import annotations
 
@@ -855,7 +856,9 @@ class MultiStageEngine:
             out_spec = (P(), P())
 
         def run(fact_cols, fact_valid, dim_cols_list, dim_valids, params):
-            kern = jax.shard_map(
+            from pinot_tpu.parallel.engine import shard_map_compat
+
+            kern = shard_map_compat(
                 shard_kernel,
                 mesh=mesh,
                 in_specs=(
@@ -866,7 +869,6 @@ class MultiStageEngine:
                     _param_specs(params),
                 ),
                 out_specs=out_spec,
-                check_vma=False,
             )
             return kern(fact_cols, fact_valid, tuple(dim_cols_list), tuple(dim_valids), params)
 

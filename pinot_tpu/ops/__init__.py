@@ -19,4 +19,11 @@ from pinot_tpu.ops.segmented import (  # noqa: F401
     masked_min,
     masked_sum,
     masked_sum_sq,
+    unpack_bitmap_words,
+)
+from pinot_tpu.ops.pallas_scan import (  # noqa: F401
+    fused_group_tables_pallas,
+    merge_sparse_tables,
+    pallas_supported,
+    scan_backend,
 )

@@ -1,0 +1,394 @@
+"""Pallas fused filter→group-by scan: one pass over HBM per macro-batch.
+
+The XLA path (ops/segmented.py) streams each macro-batch several times —
+bitmap words unpack into a row-length bool mask, limbs stack into an [n, L]
+matrix (or re-slice per chunk), and the one-hot matmuls read it all back.
+Measured ceiling ~11 Grows/s with ~27 GB/s of HBM touched per effective
+pass (VERDICT r5: 2.09e9 rows/s end-to-end on config 2, ~3% of a v5e's
+~819 GB/s).  This module fuses the whole row pipeline into ONE Pallas grid
+over row tiles, so each input byte is read exactly once:
+
+  tile load:   dict codes in STORAGE dtype (int8 stays int8 in HBM),
+               range-index prefix-bitmap WORDS ([T/32] uint32, unpacked
+               in-register), optional predicate codes
+  tile math:   dictionary-code range predicate, 8-bit-limb extraction
+               (two's-complement int32 / signed-magnitude int64 halves),
+               two-level one-hot (A, B) pair shared by every limb, one
+               [Hp, W] MXU matmul per limb column
+  tile store:  int32 accumulation into a VMEM-resident [L, Hp, W] block,
+               revisited across the tiles of one "super-segment"
+
+Exactness contract (matches segmented.fused_group_tables bit-for-bit on
+integer kinds): every limb is < 256 so each per-tile f32 dot accumulates
+< 255 * _TILE < 2^24 (exact); tiles add into int32 where one super-segment
+covers <= 2^23 rows so |sum| <= 255 * 2^23 < 2^31 (exact); the per-super
+int32 tables recombine OUTSIDE the kernel in f64 with the limb scales —
+TPU Pallas has no f64, and the recombine is table-sized anyway.  Float
+kinds (f32_sum/f32_sumsq) are NOT eligible: f32 accumulation over 2^23-row
+supers would lose vs the XLA path's per-chunk f64 combine, so the plan-time
+dispatch keeps floats on the XLA path (pallas_supported).
+
+Backend selection is a PLAN-TIME decision (scan_backend): "pallas" on TPU,
+"xla" elsewhere, overridable with PINOT_TPU_SCAN_BACKEND=pallas|xla|
+interpret — "interpret" runs this same kernel through the Pallas
+interpreter so tier-1 exercises it under JAX_PLATFORMS=cpu.
+
+Also here: merge_sparse_tables, the device-side cross-launch merge for the
+sparse group-by path (fixed-slot tables merged in-graph; see the function
+docstring) — jnp-only, so it runs on every backend.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from pinot_tpu.ops import segmented as _seg
+
+try:  # pallas ships with jax on this image; gate defensively anyway
+    from jax.experimental import pallas as pl
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover - environment without pallas
+    pl = None
+    _HAS_PALLAS = False
+
+# Rows per grid step.  Multiple of 32 so bitmap word tiles slice cleanly;
+# 4096 keeps the worst-case VMEM working set (A [T, 128] f32 + B [T, 64]
+# + one limb temp) a few MB under the 16MB budget.
+_TILE = 4096
+# Grid steps per int32 accumulator "super-segment": 2048 * 4096 = 2^23
+# rows, so a per-limb super sum is <= 255 * 2^23 < 2^31 - 1 (int32 exact).
+_SUPER_TILES = 2048
+
+_W = _seg._W  # two-level decomposition lane width (code = hi * 64 + lo)
+
+# Pallas-eligible fused entry kinds: exact integer accumulation only (see
+# module docstring for why floats stay on the XLA path).
+PALLAS_KINDS = ("count", "int_sum", "int64_sum")
+
+# same sentinel as query/planner.SPARSE_EMPTY_KEY (ops cannot import the
+# query layer); all real packed keys are >= 0 so int64 max never collides
+SPARSE_EMPTY_KEY = np.int64(np.iinfo(np.int64).max)
+
+
+@functools.lru_cache(maxsize=None)
+def scan_backend() -> str:
+    """Plan-time scan-backend selector, part of every plan-cache key.
+
+    "pallas" on a real TPU backend, "xla" everywhere else.  Env override
+    PINOT_TPU_SCAN_BACKEND in {pallas, xla, interpret}: "interpret" routes
+    plans through this kernel under the Pallas interpreter (CPU tests, the
+    bench smoke gate).  lru_cached like accum_policy — tests that flip the
+    env var must scan_backend.cache_clear()."""
+    forced = os.environ.get("PINOT_TPU_SCAN_BACKEND", "").strip().lower()
+    if forced in ("pallas", "xla", "interpret"):
+        if forced in ("pallas", "interpret") and not _HAS_PALLAS:
+            return "xla"
+        return forced
+    return "pallas" if (_HAS_PALLAS and jax.default_backend() == "tpu") else "xla"
+
+
+def pallas_supported(entries, num_groups: int) -> bool:
+    """Can fused_group_tables_pallas compute these entries exactly?
+
+    Integer-exact kinds only, group table narrow enough for the one-hot
+    matmul (the same _MATMUL_MAX_GROUPS ceiling as the XLA matmul path)."""
+    if not _HAS_PALLAS or num_groups < 1 or num_groups > _seg._MATMUL_MAX_GROUPS:
+        return False
+    for kind, values, _mask, _lp in entries:
+        if kind not in PALLAS_KINDS:
+            return False
+        if kind == "int_sum" and not (
+            jnp.issubdtype(values.dtype, jnp.integer) and values.dtype.itemsize <= 4
+        ):
+            return False
+        if kind == "int64_sum" and values.dtype != jnp.int64:
+            return False
+    return True
+
+
+def _row_iota(shape_len: int):
+    # TPU Mosaic rejects 1D iota; build [n] from a 2D one
+    return lax.broadcasted_iota(jnp.int32, (shape_len, 1), 0).reshape(shape_len)
+
+
+def fused_group_tables_pallas(
+    entries,
+    codes,
+    num_groups: int,
+    *,
+    mask_words=None,
+    code_pred: Optional[Tuple[Any, int, int]] = None,
+    interpret: bool = False,
+):
+    """Pallas twin of segmented.fused_group_tables for integer kinds.
+
+    entries: list of (kind, values, mask, limb_plan) with kind in
+    PALLAS_KINDS.  mask_words: optional packed uint32 filter bitmap
+    ([n // 32], bit r of word w covers row 32*w + r — the range-index
+    word-slice layout of query/filter.eval_bitmap) ANDed into every entry
+    mask IN-REGISTER, so the row-length bool mask never exists in HBM.
+    code_pred: optional (codes_array, lo, hi) dictionary-code range
+    predicate, likewise fused.  Returns f64[num_groups] tables in entry
+    order, bit-identical to the XLA path (both are exact integer sums).
+
+    Rows are padded to a _TILE multiple when needed (padding carries
+    mask=False, so padded rows contribute exactly nothing); 32-aligned
+    macro-batch widths make the engine's hot path pad-free."""
+    n = int(codes.shape[0])
+    if mask_words is not None and n % 32:
+        raise ValueError("mask_words requires a 32-aligned row count")
+    if not pallas_supported(entries, num_groups):
+        raise ValueError("entries not eligible for the Pallas fused scan")
+
+    T = _TILE
+    n_tiles = max(1, -(-n // T))
+    n_super = -(-n_tiles // _SUPER_TILES)
+    H = -(-num_groups // _W)
+    Hp = -(-H // 8) * 8  # pad the sublane dim for TPU tiling
+
+    inputs: List[Any] = [codes]
+    in_specs: List[Any] = [pl.BlockSpec((T,), lambda i: (i,))]
+    ix_of: Dict[int, int] = {}
+
+    def _operand(arr) -> int:
+        k = id(arr)
+        if k not in ix_of:
+            inputs.append(arr)
+            in_specs.append(pl.BlockSpec((T,), lambda i: (i,)))
+            ix_of[k] = len(inputs) - 1
+        return ix_of[k]
+
+    words_ix = None
+    if mask_words is not None:
+        inputs.append(mask_words)
+        in_specs.append(pl.BlockSpec((T // 32,), lambda i: (i,)))
+        words_ix = len(inputs) - 1
+    pred_plan = None
+    if code_pred is not None:
+        pc, plo, phi = code_pred
+        pred_plan = (_operand(pc), int(plo), int(phi))
+
+    halves_of: Dict[int, Tuple[Any, Any]] = {}
+
+    def _halves(arr):
+        """uint32 (lo, hi) halves of an int64 column, split OUTSIDE the
+        kernel — TPU Pallas has no 64-bit row ops; the bitcast is a cheap
+        elementwise pass and the kernel reads the halves once."""
+        k = id(arr)
+        if k not in halves_of:
+            h = lax.bitcast_convert_type(arr, jnp.uint32)
+            lo_ix = _seg._i64_low_half_index()
+            halves_of[k] = (h[..., lo_ix], h[..., 1 - lo_ix])
+        return halves_of[k]
+
+    plans: List[Tuple] = []  # (kind, mask_ix, value_ixs, limb_plan, col0)
+    scales_per_entry: List[List[float]] = []
+    col = 0
+    for kind, values, mask, limb_plan in entries:
+        m_ix = _operand(mask)
+        if kind == "count":
+            plans.append(("count", m_ix, (), None, col))
+            scales = [1.0]
+            col += 1
+        elif kind == "int_sum":
+            n_limbs, signed = limb_plan if limb_plan is not None else (4, True)
+            plans.append(("int_sum", m_ix, (_operand(values),), (n_limbs, signed), col))
+            scales = [float(1 << (8 * i)) for i in range(n_limbs)]
+            if signed:
+                scales.append(-float(1 << (8 * n_limbs)))
+            col += n_limbs + (1 if signed else 0)
+        else:  # int64_sum: signed-magnitude limbs (see segmented._int64_signed_limbs)
+            nl = limb_plan if limb_plan is not None else 8
+            lo_arr, hi_arr = _halves(values)
+            plans.append(("int64_sum", m_ix, (_operand(lo_arr), _operand(hi_arr)), nl, col))
+            scales = [float(1 << (8 * i)) for i in range(nl)]
+            col += nl
+        scales_per_entry.append(scales)
+    L = col
+
+    if n % T:
+        pad = n_tiles * T - n
+        padded = []
+        for ix, a in enumerate(inputs):
+            w = pad // 32 if ix == words_ix else pad
+            padded.append(jnp.pad(a, (0, w)))
+        inputs = padded
+
+    def scan_kernel(*refs):
+        out_ref = refs[-1]
+        i = pl.program_id(0)
+
+        @pl.when(i % _SUPER_TILES == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        ki = refs[0][...].astype(jnp.int32)
+        base = None
+        if words_ix is not None:
+            w = refs[words_ix][...]
+            shifts = lax.broadcasted_iota(jnp.uint32, (T // 32, 32), 1)
+            base = (((w[:, None] >> shifts) & jnp.uint32(1)) != jnp.uint32(0)).reshape(T)
+        if pred_plan is not None:
+            p_ix, plo, phi = pred_plan
+            pc = refs[p_ix][...].astype(jnp.int32)
+            pm = (pc >= plo) & (pc < phi)
+            base = pm if base is None else base & pm
+
+        # one (A, B) one-hot pair shared by EVERY limb matmul of the tile —
+        # the same sharing that makes the fused XLA scan 3x faster than
+        # per-table scans, now also sharing the single HBM read
+        A = (lax.broadcasted_iota(jnp.int32, (T, Hp), 1) == (ki // _W)[:, None]).astype(
+            jnp.float32
+        )
+        B = (lax.broadcasted_iota(jnp.int32, (T, _W), 1) == (ki % _W)[:, None]).astype(
+            jnp.float32
+        )
+
+        def accum(col_ix, wcol):
+            s = lax.dot_general(
+                A * wcol[:, None], B, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            out_ref[0, col_ix] = out_ref[0, col_ix] + s.astype(jnp.int32)
+
+        for kind, m_ix, v_ixs, lp, col0 in plans:
+            m = refs[m_ix][...]
+            if base is not None:
+                m = m & base
+            mf = m.astype(jnp.float32)
+            if kind == "count":
+                accum(col0, mf)
+            elif kind == "int_sum":
+                n_limbs, signed = lp
+                vm = jnp.where(m, refs[v_ixs[0]][...].astype(jnp.int32), 0)
+                u = vm.astype(jnp.uint32)
+                for k in range(n_limbs):
+                    accum(col0 + k, ((u >> jnp.uint32(8 * k)) & jnp.uint32(0xFF)).astype(jnp.float32))
+                if signed:
+                    accum(col0 + n_limbs, (vm < 0).astype(jnp.float32))
+            else:  # int64_sum
+                lo_h = refs[v_ixs[0]][...]
+                hi_h = refs[v_ixs[1]][...]
+                neg = hi_h >= jnp.uint32(1 << 31)
+                alo = jnp.where(neg, ~lo_h + jnp.uint32(1), lo_h)
+                ahi = jnp.where(neg, ~hi_h + (lo_h == jnp.uint32(0)).astype(jnp.uint32), hi_h)
+                sgn = jnp.where(neg, -1, 1).astype(jnp.float32) * mf
+                for k in range(lp):
+                    h = alo if k < 4 else ahi
+                    limb = ((h >> jnp.uint32(8 * (k % 4))) & jnp.uint32(0xFF)).astype(jnp.float32)
+                    accum(col0 + k, limb * sgn)
+
+    out = pl.pallas_call(
+        scan_kernel,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, L, Hp, _W), lambda i: (i // _SUPER_TILES, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_super, L, Hp, _W), jnp.int32),
+        interpret=bool(interpret),
+    )(*inputs)
+
+    # cross-super recombine in f64 (table-sized): every per-super value is
+    # an exact integer < 2^31, every partial sum stays < 2^53 under the
+    # same contract as the XLA path's per-chunk f64 combine
+    flat = out.astype(jnp.float64).sum(axis=0).reshape(L, Hp * _W)[:, :num_groups]
+    tables = []
+    for (kind, _m, _v, _lp, col0), scales in zip(plans, scales_per_entry):
+        t = flat[col0] if scales[0] == 1.0 else flat[col0] * scales[0]
+        for j, s in enumerate(scales[1:], start=1):
+            t = t + flat[col0 + j] * s
+        tables.append(t)
+    return tables
+
+
+# ---------------------------------------------------------------------------
+# Device-side sparse group-by cross-launch merge
+# ---------------------------------------------------------------------------
+def merge_sparse_tables(
+    uniq,
+    partials: Sequence[Dict[str, Any]],
+    num_slots: int,
+    field_ops: Sequence[Dict[str, str]],
+    order_spec: Optional[Tuple[int, str, bool]] = None,
+):
+    """Merge stacked fixed-slot sparse group tables ON DEVICE (VERDICT
+    weak #5): replaces the host numpy fold of sparse_tables_to_result for
+    the macro-batched path, so cross-launch combining is part of the graph
+    and only FINAL [num_slots] tables ever cross PCIe.
+
+    uniq: [M] int64 packed keys (SPARSE_EMPTY_KEY padding), the
+    concatenation of every launch's per-device [K] key tables (M = B*ndev*K).
+    partials: per-agg {field: [M]} stacked the same way.  field_ops: per-agg
+    {field: "add"|"min"|"max"} (functions.FIELD_COMBINE, passed in because
+    ops cannot import the query layer).  order_spec: (agg index, order
+    FIELD name, ascending) when an ORDER BY-aware trim applies — the
+    device analog of executor._order_trim_select: rank by the merged order
+    value (empty/NaN groups last), tie-break by packed key, keep the top
+    num_slots, and emit survivors in ascending key order so downstream
+    decode matches the host merge byte-for-byte.
+
+    The merge is sort-based over the SAME fixed-slot contract as the
+    per-launch kernel (sort keys -> segment starts -> running group id ->
+    scatter-combine), not a literal probed hash table: table-sized lax.sort
+    is TPU-native and exact, where open-addressing probe loops serialize.
+    Everything here is [M]-sized (never row-length)."""
+    M = int(uniq.shape[0])
+    uniq = uniq.astype(jnp.int64).reshape(-1)
+    iota = jnp.arange(M, dtype=jnp.int32)
+    skey, perm = lax.sort((uniq, iota), num_keys=1)
+    valid = skey != SPARSE_EMPTY_KEY
+    prev = jnp.concatenate([jnp.full((1,), np.int64(-1), skey.dtype), skey[:-1]])
+    is_start = valid & (skey != prev)
+    seg_id = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    # empty slots fold into an overflow slot M (sliced off): add-fields
+    # carry 0 there, min/max carry their identity, so it absorbs harmlessly
+    slot = jnp.where(valid, seg_id, np.int32(M))
+
+    merged: List[Dict[str, Any]] = []
+    for fops, p in zip(field_ops, partials):
+        q: Dict[str, Any] = {}
+        for fname, comb in fops.items():
+            x = p[fname].reshape(-1)[perm]
+            if comb == "add":
+                q[fname] = jnp.zeros((M + 1,), x.dtype).at[slot].add(x)
+            elif comb == "min":
+                base = jnp.full((M + 1,), jnp.asarray(np.inf, x.dtype))
+                q[fname] = base.at[slot].min(x)
+            else:
+                base = jnp.full((M + 1,), jnp.asarray(-np.inf, x.dtype))
+                q[fname] = base.at[slot].max(x)
+        merged.append(q)
+
+    gslot = jnp.where(is_start, seg_id, np.int32(M))
+    gkey = (
+        jnp.full((M + 1,), SPARSE_EMPTY_KEY, jnp.int64)
+        .at[gslot]
+        .set(jnp.where(is_start, skey, SPARSE_EMPTY_KEY))
+    )
+    phantom = gkey == SPARSE_EMPTY_KEY  # slots past the last real group
+    if order_spec is None:
+        # lowest packed keys win — the deterministic numGroupsLimit trim
+        ovk = jnp.where(phantom, jnp.inf, 0.0)
+    else:
+        oi, field, asc = order_spec
+        ov = merged[oi][field].astype(jnp.float64)
+        cnt = merged[oi].get("count")
+        if cnt is not None:
+            # SUM/MIN/MAX over zero agg-mask rows is SQL NULL: rank last,
+            # mirroring AggFunction.final's count>0 guard on the host
+            ov = jnp.where(cnt.astype(jnp.float64) > 0, ov, jnp.nan)
+        ovk = ov if asc else -ov
+        ovk = jnp.where(jnp.isnan(ovk) | phantom, jnp.inf, ovk)
+    slots = jnp.arange(M + 1, dtype=jnp.int32)
+    _, _, ranked = lax.sort((ovk, gkey, slots), num_keys=2)
+    selmask = jnp.zeros((M + 1,), bool).at[ranked[:num_slots]].set(True)
+    outkey = jnp.where(selmask & ~phantom, gkey, SPARSE_EMPTY_KEY)
+    okey, operm = lax.sort((outkey, slots), num_keys=1)
+    out = [{f: t[operm][:num_slots] for f, t in q.items()} for q in merged]
+    return okey[:num_slots], out

@@ -81,6 +81,14 @@ def _i32(codes):
     return codes.astype(jnp.int32)
 
 
+def unpack_bitmap_words(words, n: int):
+    """[n // 32] uint32 packed filter words -> [n] bool row mask (bit r of
+    word w covers row 32 * w + r — the query/filter.eval_bitmap layout).
+    The XLA-path materialization of the mask the Pallas scan keeps packed."""
+    bits = ((words[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)) != 0
+    return bits.reshape(-1)[:n]
+
+
 # ---------------------------------------------------------------------------
 # Scatter primitives (explicit int32 indices)
 # ---------------------------------------------------------------------------
@@ -366,7 +374,7 @@ def _entry_limbs(kind, values, mask, limb_plan, dt):
     return [jnp.where(mask, v * v, np.float32(0.0))], [1.0]
 
 
-def fused_group_tables(entries, codes, num_groups: int):
+def fused_group_tables(entries, codes, num_groups: int, backend=None, mask_words=None):
     """Compute many additive group tables in ONE chunked one-hot-matmul scan.
 
     entries: list of (kind, values, mask, limb_plan); kind in FUSED_KINDS,
@@ -374,10 +382,32 @@ def fused_group_tables(entries, codes, num_groups: int):
     Returns a list of f64[num_groups] tables in entry order ("count" entries
     are exact integer-valued f64; callers cast).
 
+    backend: plan-time scan-backend tag ("pallas" | "interpret" | "xla" |
+    None).  "pallas"/"interpret" dispatch Pallas-eligible entry sets (exact
+    integer kinds, narrow-enough table — pallas_scan.pallas_supported) to
+    the fused single-HBM-pass kernel; everything else stays here.
+    mask_words: optional packed uint32 filter bitmap ([n // 32], the
+    range-index word-slice layout) ANDed into every entry mask — the Pallas
+    kernel unpacks it in-register; the XLA path unpacks it once up front.
+
     Exactness: int_sum limbs (< 256) and count flags are exact in bf16; each
     per-chunk MXU dot accumulates < 2^24 in f32 (exact); cross-chunk
     accumulation is f64.  f32_sum/f32_sumsq share the scan by promoting the
     one-hot matrices to f32 (int limbs stay exact there too)."""
+    if backend in ("pallas", "interpret"):
+        from pinot_tpu.ops import pallas_scan  # lazy: keeps import DAG flat
+
+        if pallas_scan.pallas_supported(entries, num_groups):
+            return pallas_scan.fused_group_tables_pallas(
+                entries, codes, num_groups,
+                mask_words=mask_words,
+                interpret=(backend == "interpret"),
+            )
+    if mask_words is not None:
+        # declined the Pallas path (wide table, float kinds, CPU policy):
+        # fall back to one explicit unpack shared by every entry
+        row_mask = unpack_bitmap_words(mask_words, codes.shape[0])
+        entries = [(k, v, m & row_mask, lp) for k, v, m, lp in entries]
     if accum_policy() == "wide" or num_groups > _MATMUL_MAX_GROUPS:
         return [_entry_fallback(k, v, m, codes, num_groups) for k, v, m, _ in entries]
 
@@ -388,11 +418,23 @@ def fused_group_tables(entries, codes, num_groups: int):
     # Estimate the [n, L] stacked-limb footprint; past the budget, limbs
     # extract INSIDE the scan body from the raw (values, mask) chunks —
     # VMEM-resident, ~25% slower per chunk but it removes the multi-GB HBM
-    # intermediate that OOMed the 1B-row bench.
+    # intermediate that OOMed the 1B-row bench.  Dead-bytes rule: even under
+    # the budget, when the widened stack would out-weigh the RAW inputs
+    # (e.g. an int8 dict column fanning out to L bf16 limb columns) the
+    # in-chunk form wins — it streams the narrow storage bytes instead of
+    # writing back a wider copy of them.
     n_rows = codes.shape[0]
     L = sum(_entry_width(kind, limb_plan) for kind, _, _, limb_plan in entries)
     stack_bytes = n_rows * L * jnp.dtype(dt).itemsize
-    if stack_bytes > _FUSED_STACK_BYTES:
+    raw_ids = {id(codes): codes.dtype.itemsize}
+    for _, values, mask, _ in entries:
+        if values is not None:
+            raw_ids[id(values)] = values.dtype.itemsize
+        raw_ids[id(mask)] = mask.dtype.itemsize
+    raw_bytes = n_rows * sum(raw_ids.values())
+    if stack_bytes > _FUSED_STACK_BYTES or (
+        stack_bytes > raw_bytes and n_rows >= 4 * _CHUNK
+    ):
         flat, slices = _fused_scan_inchunk(entries, codes, num_groups, dt, H)
     else:
         cols = []

@@ -46,6 +46,19 @@ from pinot_tpu.query.result import (
 from pinot_tpu.query.transform import as_row_array, eval_expr
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: new jax exposes it top-level with
+    `check_vma`; older releases (<= 0.4.x, this image) only have
+    jax.experimental.shard_map with the `check_rep` spelling."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
 def _psum_field(name: str, x, axis: str):
     op = FIELD_COMBINE[name]
     if op == "add":
@@ -167,12 +180,21 @@ class _DistPlan:
     # not-yet-covered within-batch column (tail overlap masking)
     batch_docs: int = 0
     batch_offsets: Tuple[Tuple[int, int], ...] = ((0, 0),)
+    # jitted device-side cross-launch merge for the sparse group-by path
+    # (ops.merge_sparse_tables); None falls back to the host numpy merge
+    sparse_merge_fn: Optional[Callable] = None
 
 
 class DistributedEngine:
     """Executes queries over a StackedTable sharded on a device mesh."""
 
-    def __init__(self, mesh=None, axis: str = "seg", launch_bytes: Optional[int] = None):
+    def __init__(
+        self,
+        mesh=None,
+        axis: str = "seg",
+        launch_bytes: Optional[int] = None,
+        pipeline_depth: Optional[int] = None,
+    ):
         import os
 
         if mesh is None:
@@ -189,6 +211,17 @@ class DistributedEngine:
             launch_bytes
             if launch_bytes is not None
             else int(os.environ.get("PINOT_TPU_LAUNCH_BYTES", str(2 << 30)))
+        )
+        # max in-flight macro-batch launches: 2 = double-buffering (dispatch
+        # batch k+1 while batch k computes, hiding the host dispatch gap the
+        # r5 timing_pairs spread exposed); 1 = the old fully-serialized loop.
+        # Each in-flight launch holds a capture copy of its batch inputs, so
+        # resident HBM scales with depth — _batching sizes batches against
+        # launch_bytes, keeping depth * batch_bytes bounded.
+        self.pipeline_depth = (
+            pipeline_depth
+            if pipeline_depth is not None
+            else int(os.environ.get("PINOT_TPU_PIPELINE_DEPTH", "2"))
         )
 
     @property
@@ -226,7 +259,10 @@ class DistributedEngine:
 
         if ctx.joins:
             return self._mse().execute(ctx)
+        from pinot_tpu.utils.metrics import Trace
+
         t0 = time.perf_counter()
+        trace = Trace(bool(ctx.options.get("trace", False)))
         stacked = self.tables[ctx.table]
         self._inject_sketch_info(ctx, stacked)
         stats = ExecutionStats(
@@ -235,10 +271,16 @@ class DistributedEngine:
             num_docs_scanned=stacked.num_docs,
             total_docs=stacked.num_docs,
         )
-        plan = self._plan(ctx, stacked)
+        with trace.span("plan"):
+            plan = self._plan(ctx, stacked)
         stats.add_index_uses(plan.index_uses)
-        result = self._run(ctx, plan, stacked, stats)
-        out = reduce_mod.reduce_results(ctx, [result], stats)
+        with trace.span("run"):
+            result = self._run(ctx, plan, stacked, stats, trace)
+        with trace.span("reduce"):
+            out = reduce_mod.reduce_results(ctx, [result], stats)
+        t = trace.finish()
+        if t is not None:
+            out.stats.trace = t
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         return out
 
@@ -272,6 +314,7 @@ class DistributedEngine:
         batch_docs, batch_offsets = self._batching(ctx, stacked)
         key = (
             ctx.fingerprint(), stacked.signature(), self.axis, self.num_devices, batch_docs,
+            ops.scan_backend(),  # pallas/xla plans trace different kernels
         )
         cached = self._plan_cache.get(key)
         if cached is not None:
@@ -373,6 +416,12 @@ class DistributedEngine:
 
         fc = FilterCompiler(view, ctx.null_handling)
         filter_fn = fc.compile(ctx.filter)
+        # set when the WHOLE filter resolved to one plain index bitmap: the
+        # fused Pallas scan can then consume the packed words directly and
+        # the row-length bool mask never exists in HBM (capture before the
+        # per-agg FILTER compiles below reuse the compiler)
+        word_key = fc.sole_bitmap_param
+        scan_be = ops.scan_backend()  # plan-time backend decision (cache-keyed)
         agg_specs = list(ctx.aggregations)
         aggs = planner_mod.bind_aggs(agg_specs, stacked, ctx)
         agg_filter_fns = [fc.compile(s.filter) if s.filter is not None else None for s in agg_specs]
@@ -417,6 +466,8 @@ class DistributedEngine:
                 key = code if key is None else key * np.int32(gd.cardinality) + code
             return key
 
+        sparse_merge_fn = None  # set by the groupby_sparse branch when eligible
+
         if kind == "aggregation":
 
             def shard_kernel(cols, params):
@@ -434,23 +485,60 @@ class DistributedEngine:
 
         elif kind == "groupby_dense":
             vranges = planner_mod.agg_vranges(agg_specs, stacked)
-
-            def shard_kernel(cols, params):
-                cols = _flat(cols)
-                tmask, _ = filter_fn(cols, params)
-                vm = _valid_mask(params)
-                if vm is not None:
-                    tmask = tmask & vm
-                key = _group_key(cols)
-                inputs = _agg_inputs(cols, params, tmask)
-                presence, partials = planner_mod.grouped_partials(
-                    aggs, inputs, tmask, key, num_groups, vranges
+            # Word fusion: when the whole filter is one plain index bitmap
+            # and every aggregation is fully fusable (count/sum/sumsq field
+            # kinds only — scatter and sketch paths never see packed words),
+            # hand the PACKED words straight to the fused scan; the Pallas
+            # kernel unpacks them in-register, so the filter costs 1 bit of
+            # HBM per row instead of an unpacked bool byte.
+            fuse_words = (
+                scan_be in ("pallas", "interpret")
+                and word_key is not None
+                and all(fn.field_kinds is not None for fn in aggs)
+                and all(
+                    k in ("count", "sum", "sumsq")
+                    for fn in aggs
+                    for k in fn.field_kinds.values()
                 )
-                presence = lax.psum(presence, axis)
-                partials = [
-                    {f: _psum_field(f, x, axis) for f, x in p.items()} for p in partials
-                ]
-                return presence, partials
+            )
+
+            if fuse_words:
+
+                def shard_kernel(cols, params):
+                    cols = _flat(cols)
+                    vm = _valid_mask(params)
+                    tmask = vm if vm is not None else jnp.ones((local_rows,), bool)
+                    key = _group_key(cols)
+                    inputs = _agg_inputs(cols, params, tmask)
+                    presence, partials = planner_mod.grouped_partials(
+                        aggs, inputs, tmask, key, num_groups, vranges,
+                        backend=scan_be,
+                        mask_words=params[word_key].reshape(-1),
+                    )
+                    presence = lax.psum(presence, axis)
+                    partials = [
+                        {f: _psum_field(f, x, axis) for f, x in p.items()} for p in partials
+                    ]
+                    return presence, partials
+
+            else:
+
+                def shard_kernel(cols, params):
+                    cols = _flat(cols)
+                    tmask, _ = filter_fn(cols, params)
+                    vm = _valid_mask(params)
+                    if vm is not None:
+                        tmask = tmask & vm
+                    key = _group_key(cols)
+                    inputs = _agg_inputs(cols, params, tmask)
+                    presence, partials = planner_mod.grouped_partials(
+                        aggs, inputs, tmask, key, num_groups, vranges, backend=scan_be
+                    )
+                    presence = lax.psum(presence, axis)
+                    partials = [
+                        {f: _psum_field(f, x, axis) for f, x in p.items()} for p in partials
+                    ]
+                    return presence, partials
 
             out_specs = P()
 
@@ -481,6 +569,43 @@ class DistributedEngine:
                 )
 
             out_specs = P(self.axis)
+
+            # Device-side cross-launch merge (ops.merge_sparse_tables): the
+            # stacked [B*ndev*K] per-launch tables combine in-graph and only
+            # the FINAL [num_slots] tables cross PCIe — replacing the host
+            # numpy fold of sparse_tables_to_result.  Eligible when every
+            # aggregation merges field-wise (field_kinds set, no pairwise
+            # merge) and any ORDER BY-aware trim is expressible on device
+            # (kernel_order_spec); otherwise the host merge remains.
+            sparse_merge_fn = None
+            merge_ok = all(
+                fn.field_kinds is not None and not fn.pairwise_merge for fn in aggs
+            )
+            morder = None
+            if merge_ok and planner_mod.order_by_agg_index(ctx) is not None:
+                if order_spec is None:
+                    merge_ok = False  # host ranks via fn.final; not derivable here
+                else:
+                    morder = order_spec  # (agg index, order FIELD name, asc)
+            if merge_ok:
+                field_ops = [
+                    {f: FIELD_COMBINE[f] for f in fn.fields} for fn in aggs
+                ]
+
+                def _merge(uniq_list, parts_list):
+                    uniq = jnp.concatenate([u.reshape(-1) for u in uniq_list])
+                    parts = [
+                        {
+                            f: jnp.concatenate([p[i][f].reshape(-1) for p in parts_list])
+                            for f in field_ops[i]
+                        }
+                        for i in range(len(field_ops))
+                    ]
+                    return ops.merge_sparse_tables(
+                        uniq, parts, num_slots, field_ops, order_spec=morder
+                    )
+
+                sparse_merge_fn = jax.jit(_merge)
 
         else:  # selection
 
@@ -528,7 +653,7 @@ class DistributedEngine:
         row_sharded = frozenset(fc.row_sharded_params)
 
         def run(cols, params):
-            kern = jax.shard_map(
+            kern = shard_map_compat(
                 shard_kernel,
                 mesh=mesh,
                 in_specs=(
@@ -536,7 +661,6 @@ class DistributedEngine:
                     {k: (P(axis, None) if k in row_sharded else P()) for k in params},
                 ),
                 out_specs=out_specs,
-                check_vma=False,
             )
             return kern(cols, params)
 
@@ -562,6 +686,7 @@ class DistributedEngine:
             index_uses=tuple(fc.index_uses),
             batch_docs=batch_docs,
             batch_offsets=tuple(batch_offsets),
+            sparse_merge_fn=sparse_merge_fn,
         )
 
     # ------------------------------------------------------------------
@@ -613,14 +738,40 @@ class DistributedEngine:
             ]
         return out
 
-    def _run(self, ctx, plan: _DistPlan, stacked, stats: ExecutionStats):
-        # Launches are SERIALIZED (device_get per batch): each in-flight
-        # execution holds a capture copy of its batch inputs; overlapping B
-        # launches would re-create the whole-table copy the batching exists
-        # to avoid.  With one batch this is the plain async dispatch.
+    def _drain(self, out, keep_device: bool):
+        """Completion fence for one in-flight launch.  keep_device leaves the
+        output tables on device (the sparse merge consumes them in-graph) and
+        fences on a single table-sized leaf instead of copying everything —
+        one small device_get, not a per-launch block_until_ready."""
+        if keep_device:
+            jax.device_get(jax.tree_util.tree_leaves(out)[0])
+            return out
+        return jax.device_get(out)
+
+    def _run(self, ctx, plan: _DistPlan, stacked, stats: ExecutionStats, trace=None):
+        from pinot_tpu.utils.metrics import Trace
+
+        if trace is None:
+            trace = Trace(False)
+        # Launches are PIPELINED up to pipeline_depth in flight (default 2 =
+        # double-buffering): batch k+1 dispatches while batch k computes,
+        # hiding the host-side dispatch/relay gap between launches.  Each
+        # in-flight execution holds a capture copy of its batch inputs, so
+        # resident HBM is bounded by depth * batch bytes (depth=1 restores
+        # the old fully-serialized loop).  The fence is a device_get of the
+        # oldest launch's output — never a per-launch block_until_ready.
+        depth = max(1, int(self.pipeline_depth))
+        # device merge consumes sparse outputs in-graph: keep them on device
+        keep_device = plan.kind == "groupby_sparse" and plan.sparse_merge_fn is not None
         batch_outs = []
-        for cols, params in self.device_batches(plan, stacked):
-            batch_outs.append(jax.device_get(plan.fn(cols, params)))
+        pending: List[Any] = []
+        with trace.span("launches"):
+            for cols, params in self.device_batches(plan, stacked):
+                pending.append(plan.fn(cols, params))
+                if len(pending) >= depth:
+                    batch_outs.append(self._drain(pending.pop(0), keep_device))
+            while pending:
+                batch_outs.append(self._drain(pending.pop(0), keep_device))
 
         if plan.kind == "aggregation":
             partials = self._combine_partials(batch_outs)
@@ -651,20 +802,37 @@ class DistributedEngine:
             return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense)
 
         if plan.kind == "groupby_sparse":
-            # batches concatenate like extra devices: sparse_tables_to_result
-            # merges duplicate keys across the [B*ndev*K] stacked tables
-            uniq = np.concatenate([np.asarray(u).reshape(-1) for u, _ in batch_outs])
-            partials = [
-                {
-                    f: np.concatenate([np.asarray(p[i][f]) for _, p in batch_outs])
-                    for f in batch_outs[0][1][i]
-                }
-                for i in range(len(batch_outs[0][1]))
-            ]
-            res = sse_executor.sparse_tables_to_result(
-                plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit,
-                order_trim=planner_mod.order_by_agg_index(ctx),
-            )
+            if plan.sparse_merge_fn is not None:
+                # device merge: the [B*ndev*K] stacked tables combine
+                # in-graph (ops.merge_sparse_tables, order-aware trim
+                # included) and only the final [num_slots] tables come home
+                with trace.span("sparse_merge:device"):
+                    merged = plan.sparse_merge_fn(
+                        [u for u, _ in batch_outs], [p for _, p in batch_outs]
+                    )
+                    uniq, partials = jax.device_get(merged)
+                res = sse_executor.sparse_tables_to_result(
+                    plan.group_dims, plan.aggs, np.asarray(uniq), partials,
+                    ctx.num_groups_limit, order_trim=None, assume_unique=True,
+                )
+                stats.num_groups = len(res.keys[0]) if res.keys else 0
+                return res
+            # host fallback (pairwise-merge partials or an ORDER BY rank the
+            # device cannot derive): batches concatenate like extra devices
+            # and sparse_tables_to_result folds duplicate keys on host
+            with trace.span("sparse_merge:host"):
+                uniq = np.concatenate([np.asarray(u).reshape(-1) for u, _ in batch_outs])
+                partials = [
+                    {
+                        f: np.concatenate([np.asarray(p[i][f]) for _, p in batch_outs])
+                        for f in batch_outs[0][1][i]
+                    }
+                    for i in range(len(batch_outs[0][1]))
+                ]
+                res = sse_executor.sparse_tables_to_result(
+                    plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit,
+                    order_trim=planner_mod.order_by_agg_index(ctx),
+                )
             stats.num_groups = len(res.keys[0]) if res.keys else 0
             return res
 

@@ -231,6 +231,7 @@ def _dense_to_present(
 def sparse_tables_to_result(
     group_dims, aggs, uniq, partials, num_groups_limit: int,
     order_trim: Optional[Tuple[int, bool]] = None,
+    assume_unique: bool = False,
 ) -> GroupBySegmentResult:
     """Decode fixed-size sparse group tables (planner.sparse_grouped_tables)
     into a GroupBySegmentResult, merging slots that share a key.
@@ -239,9 +240,24 @@ def sparse_tables_to_result(
     and the multi-device shape ([ndev*K] concatenated per-device tables,
     where the same key may appear on several devices — the IndexedTable
     merge the reference runs in CombineOperator).  Only table-sized arrays
-    are touched; nothing here is row-length."""
+    are touched; nothing here is row-length.
+
+    assume_unique: the caller already merged duplicate keys (the device-side
+    ops.merge_sparse_tables path) — keys are unique, ascending, and any
+    order-aware trim has been applied; this just drops empty padding slots
+    and decodes, no unique/fold pass."""
     uniq = np.asarray(uniq).reshape(-1)
     present = uniq != planner.SPARSE_EMPTY_KEY
+    if assume_unique:
+        u = uniq[present]
+        if len(u) > num_groups_limit:  # defensive; device merge already trims
+            present = present & (np.cumsum(present) <= num_groups_limit)
+            u = u[:num_groups_limit]
+        out = [
+            {f: np.asarray(arr)[present] for f, arr in p.items()} for p in partials
+        ]
+        keys = planner.decode_packed_keys(group_dims, u)
+        return GroupBySegmentResult(keys=keys, partials=out, dense=None)
     keys_flat = uniq[present]
     u, inverse = np.unique(keys_flat, return_inverse=True)
     if len(u) > num_groups_limit and order_trim is None:

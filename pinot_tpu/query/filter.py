@@ -131,6 +131,14 @@ class FilterCompiler:
         self.bitmap_layout: Optional[Tuple[int, int, int]] = getattr(segment, "bitmap_layout", None)
         # param keys whose leading axis is the device axis (in_spec P(axis))
         self.row_sharded_params: set = set()
+        # bitmap param keys that are PLAIN (not negated, no null guard) —
+        # candidates for staying packed through a fused Pallas scan
+        self._plain_bitmaps: set = set()
+        # set when the ROOT filter is exactly one plain bitmap predicate:
+        # the engine can then skip the unpack entirely and hand the packed
+        # words to the fused scan (pallas_scan word-slicing)
+        self.sole_bitmap_param: Optional[str] = None
+        self._root_compiled = False
 
     def _key(self, suffix: str) -> str:
         k = f"f{self._counter}.{suffix}"
@@ -152,6 +160,8 @@ class FilterCompiler:
 
     # ------------------------------------------------------------------
     def compile(self, node: Optional[FilterNode]) -> Callable[[Dict, Dict], MaskPair]:
+        is_root = not self._root_compiled
+        self._root_compiled = True
         if node is None:
             n = self.segment.num_docs
 
@@ -159,7 +169,13 @@ class FilterCompiler:
                 return jnp.ones((n,), dtype=bool), None
 
             return match_all
-        return self._compile_node(node)
+        before_keys = set(self.params)
+        fn = self._compile_node(node)
+        if is_root and node.op is FilterOp.PRED:
+            new_keys = set(self.params) - before_keys
+            if len(new_keys) == 1 and next(iter(new_keys)) in self._plain_bitmaps:
+                self.sole_bitmap_param = next(iter(new_keys))
+        return fn
 
     def _compile_node(self, node: FilterNode) -> Callable[[Dict, Dict], MaskPair]:
         if node.op is FilterOp.PRED:
@@ -479,6 +495,8 @@ class FilterCompiler:
             words = words.reshape(ndev, local_rows // 32)
             self.row_sharded_params.add(key)
         self.params[key] = words
+        if not negate and not has_nulls:
+            self._plain_bitmaps.add(key)
         self._null_guard(name, has_nulls)
         self.index_uses.append((name, kind))
 

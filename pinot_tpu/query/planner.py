@@ -554,7 +554,8 @@ def agg_vranges(agg_specs, table_like) -> List[Optional[Tuple[int, int]]]:
     return out
 
 
-def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges):
+def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges,
+                     backend=None, mask_words=None):
     """Presence table + per-agg grouped partial dicts for the dense path.
 
     All additive fields (presence, counts, sums, sums of squares) across ALL
@@ -562,7 +563,25 @@ def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges):
     (ops.fused_group_tables) — one (A, B) one-hot pair per chunk instead of
     one per table, the single biggest kernel-time win of round 2.  min/max
     fields scatter (no matmul semiring); sketch functions (field_kinds None)
-    run their own partial_grouped."""
+    run their own partial_grouped.
+
+    backend tags the plan-time scan backend (ops.scan_backend()) so eligible
+    entry sets route to the Pallas fused kernel.  mask_words optionally
+    carries the filter as PACKED uint32 bitmap words instead of folded into
+    tmask/input masks — the Pallas scan unpacks them in-register.  Scatter
+    and sketch paths never see packed words, so they are defensively
+    unpacked here whenever any aggregation needs a non-fusable field."""
+    if mask_words is not None:
+        fuse_ok = all(fn.field_kinds is not None for fn in aggs) and all(
+            k in ("count", "sum", "sumsq")
+            for fn in aggs
+            for k in fn.field_kinds.values()
+        )
+        if not fuse_ok:
+            row_mask = ops.unpack_bitmap_words(mask_words, tmask.shape[0])
+            tmask = tmask & row_mask
+            inputs = [(v, m & row_mask) for v, m in inputs]
+            mask_words = None
     entries: List[Tuple] = []
     slot_of: Dict[Tuple, int] = {}
 
@@ -611,7 +630,9 @@ def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges):
                 fmap[field] = (kind, None)  # min/max: scatter below
         requests.append(("fields", fmap))
 
-    tables = ops.fused_group_tables(entries, key, num_groups)
+    tables = ops.fused_group_tables(
+        entries, key, num_groups, backend=backend, mask_words=mask_words
+    )
 
     def _as_table(idx):
         t = tables[idx]
@@ -874,6 +895,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     key = (
         ctx.fingerprint(),
         _segment_signature(segment, needed, sketch_bound_columns(ctx) | const_bound_columns(ctx)),
+        ops.scan_backend(),  # pallas/xla plans trace different kernels
     )
     cached = _PLAN_CACHE.get(key)
     if cached is not None:
@@ -1047,13 +1069,16 @@ def _build_plan(
         ]
         return key.reshape(-1), t2.reshape(-1), flat_inputs
 
+    scan_be = ops.scan_backend()  # plan-time backend decision (cache-keyed)
+
     if kind == "groupby_dense" and mv_i is not None:
         vranges = agg_vranges(agg_specs, segment)
 
         def kernel(cols, params):
             tmask, _ = filter_fn(cols, params)
             key, t_f, inputs = _mv_explode(cols, params, tmask, jnp.int32)
-            return grouped_partials(aggs, inputs, t_f, key, num_groups, vranges)
+            return grouped_partials(aggs, inputs, t_f, key, num_groups, vranges,
+                                    backend=scan_be)
 
     elif kind == "groupby_dense":
         vranges = agg_vranges(agg_specs, segment)
@@ -1062,7 +1087,8 @@ def _build_plan(
             tmask, _ = filter_fn(cols, params)
             key = _group_key(cols, params)
             inputs = _agg_inputs(cols, params, tmask)
-            return grouped_partials(aggs, inputs, tmask, key, num_groups, vranges)
+            return grouped_partials(aggs, inputs, tmask, key, num_groups, vranges,
+                                    backend=scan_be)
 
     elif kind == "groupby_sparse":
         # Device-side sort+scatter into fixed [numGroupsLimit] tables — no

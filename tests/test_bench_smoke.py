@@ -1,0 +1,48 @@
+"""Smoke-run bench.py end to end on a small row count, both scan backends.
+
+This is the in-image gate for the headline bench: it must run, ride the
+range index, and emit one parseable JSON line carrying the round-6 fields
+(`backend`, `effective_bytes_per_sec`) alongside the round-5 schema.
+Marked slow — tier-1 runs with `-m 'not slow'`; CI or a human runs
+`pytest -m slow` before publishing numbers."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["xla", "interpret"])
+def test_bench_emits_json_with_bandwidth_fields(backend):
+    env = dict(os.environ)
+    env.update(
+        {
+            "BENCH_ROWS": str(1 << 20),
+            "PINOT_TPU_SCAN_BACKEND": backend,
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO,
+        timeout=600,
+        check=True,
+    )
+    # the JSON line is the last non-empty stdout line
+    line = [l for l in out.stdout.splitlines() if l.strip()][-1]
+    rec = json.loads(line)
+    assert rec["backend"] == backend
+    assert rec["rows"] == 1 << 20
+    assert rec["effective_bytes_per_sec"] > 0
+    # derivation sanity: bytes/s = rows/s * bytes/row, with bytes/row
+    # between the 2 needed columns' floor and a generous 64-byte ceiling
+    bpr = rec["effective_bytes_per_sec"] / rec["value"]
+    assert 4 <= bpr <= 64
+    assert rec["filter_index_uses"], "bench filter must ride the range index"

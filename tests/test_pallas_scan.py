@@ -1,0 +1,320 @@
+"""Pallas fused filter→group-by scan (single-chip throughput push).
+
+Exactness contract: the Pallas kernel (run here in interpret mode — tier-1
+is JAX_PLATFORMS=cpu) must match the XLA segmented path bit-for-bit for
+every integer kind it claims (count / int_sum / int64_sum), including the
+in-register word-mask and dict-code-predicate fusion and the row-padding
+tail.  The engine-level tests prove plan-time routing: the same query
+returns identical rows under backend=xla and backend=interpret, the
+word-fused dense kernel really rides the range-index bitmap, the sparse
+cross-launch merge happens ON DEVICE (trace spans), and the
+double-buffered launch pipeline is deterministic across depths."""
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pinot_tpu import ops
+from pinot_tpu.ops import pallas_scan, segmented
+from pinot_tpu.parallel.engine import DistributedEngine
+from pinot_tpu.parallel.stacked import StackedTable
+from pinot_tpu.spi.config import IndexingConfig, TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+from pinot_tpu.sql.parser import parse_query
+
+
+pytestmark = pytest.mark.skipif(
+    not pallas_scan._HAS_PALLAS, reason="jax.experimental.pallas unavailable"
+)
+
+
+def _reference(entries, codes, num_groups):
+    return [
+        np.asarray(segmented._entry_fallback(k, v, m, codes, num_groups), np.float64)
+        for k, v, m, _ in entries
+    ]
+
+
+def _entries(rng, n):
+    """One entry per supported kind, with signs and widths that exercise
+    every limb column (int8 negative, int32 full range, int64 past int32).
+    int64 magnitudes stay under 2^39 so worst-case group sums remain inside
+    the f64 integer-exact window — the same output contract as the XLA
+    path, whose tables are also f64."""
+    m = lambda: rng.random(n) < 0.8
+    return [
+        ("count", jnp.zeros((n,), jnp.int32), jnp.asarray(m()), None),
+        ("int_sum", jnp.asarray(rng.integers(-120, 120, n).astype(np.int8)), jnp.asarray(m()), (1, True)),
+        ("int_sum", jnp.asarray(rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32)), jnp.asarray(m()), (4, True)),
+        ("int64_sum", jnp.asarray(rng.integers(-(2**39), 2**39, n).astype(np.int64)), jnp.asarray(m()), None),
+    ]
+
+
+@pytest.mark.parametrize("n", [32, 4096, 4096 * 2 + 32, 1000])  # 1000: pad tail
+@pytest.mark.parametrize("num_groups", [1, 7, 300])
+def test_exactness_vs_xla(rng, n, num_groups):
+    entries = _entries(rng, n)
+    codes = jnp.asarray(rng.integers(0, num_groups, n).astype(np.int32))
+    got = pallas_scan.fused_group_tables_pallas(
+        entries, codes, num_groups, interpret=True
+    )
+    ref = _reference(entries, codes, num_groups)
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(g), r)
+
+
+def test_word_mask_and_code_pred_fusion(rng):
+    """Packed bitmap words + dict-code range predicate, fused in-register,
+    must equal the same filter applied as an unpacked row mask."""
+    n = 4096 * 3 + 32
+    entries = _entries(rng, n)
+    codes = jnp.asarray(rng.integers(0, 50, n).astype(np.int32))
+    bits = rng.random(n) < 0.5
+    words = jnp.asarray(
+        np.packbits(bits.reshape(-1, 32), axis=1, bitorder="little")
+        .view(np.uint32)
+        .reshape(-1)
+    )
+    lo, hi = 10, 40
+    got = pallas_scan.fused_group_tables_pallas(
+        entries, codes, 50, mask_words=words, code_pred=(codes, lo, hi), interpret=True
+    )
+    unpacked = np.asarray(segmented.unpack_bitmap_words(words, n))
+    pred = (np.asarray(codes) >= lo) & (np.asarray(codes) < hi)
+    ref_entries = [
+        (k, v, jnp.asarray(np.asarray(m) & unpacked & pred), lp)
+        for k, v, m, lp in entries
+    ]
+    ref = _reference(ref_entries, codes, 50)
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(g), r)
+
+
+def test_word_mask_requires_alignment(rng):
+    n = 40  # not a multiple of 32
+    entries = [("count", jnp.zeros((n,), jnp.int32), jnp.ones((n,), bool), None)]
+    with pytest.raises(ValueError):
+        pallas_scan.fused_group_tables_pallas(
+            entries,
+            jnp.zeros((n,), jnp.int32),
+            4,
+            mask_words=jnp.zeros((2,), jnp.uint32),
+            interpret=True,
+        )
+
+
+def test_pallas_supported_gates():
+    n = 64
+    count = ("count", jnp.zeros((n,), jnp.int32), jnp.ones((n,), bool), None)
+    fsum = ("f32_sum", jnp.zeros((n,), jnp.float32), jnp.ones((n,), bool), None)
+    assert pallas_scan.pallas_supported([count], 16)
+    assert not pallas_scan.pallas_supported([count, fsum], 16)  # float kind
+    assert not pallas_scan.pallas_supported([count], 0)
+    assert not pallas_scan.pallas_supported([count], segmented._MATMUL_MAX_GROUPS + 1)
+    wide = ("int_sum", jnp.zeros((n,), jnp.int64), jnp.ones((n,), bool), None)
+    assert not pallas_scan.pallas_supported([wide], 16)  # int_sum must be <=4 bytes
+
+
+def test_scan_backend_env(monkeypatch):
+    monkeypatch.setenv("PINOT_TPU_SCAN_BACKEND", "interpret")
+    ops.scan_backend.cache_clear()
+    assert ops.scan_backend() == "interpret"
+    monkeypatch.setenv("PINOT_TPU_SCAN_BACKEND", "pallas")
+    ops.scan_backend.cache_clear()
+    assert ops.scan_backend() == "pallas"
+    monkeypatch.delenv("PINOT_TPU_SCAN_BACKEND")
+    ops.scan_backend.cache_clear()
+    assert ops.scan_backend() == "xla"  # CPU default: Pallas only on TPU
+    ops.scan_backend.cache_clear()
+
+
+def test_merge_sparse_tables_folds_duplicates():
+    E = int(pallas_scan.SPARSE_EMPTY_KEY)
+    uniq = jnp.asarray(np.array([5, 2, E, 2, 9, E, 5, 1], np.int64))
+    s = jnp.asarray(np.array([10, 1, 0, 2, 7, 0, 5, 3], np.float64))
+    c = jnp.asarray(np.array([2, 1, 0, 1, 1, 0, 1, 1], np.float64))
+    keys, tables = pallas_scan.merge_sparse_tables(
+        uniq, [{"sum": s, "count": c}], 8, [{"sum": "add", "count": "add"}]
+    )
+    keys, t = np.asarray(keys), {f: np.asarray(v) for f, v in tables[0].items()}
+    present = keys != E
+    assert list(keys[present]) == [1, 2, 5, 9]
+    np.testing.assert_array_equal(t["sum"][present], [3, 3, 15, 7])
+    np.testing.assert_array_equal(t["count"][present], [1, 2, 3, 1])
+
+
+def test_merge_sparse_tables_min_max_identities():
+    """Empty slots must not poison MIN/MAX (identity padding on device)."""
+    E = int(pallas_scan.SPARSE_EMPTY_KEY)
+    uniq = jnp.asarray(np.array([3, E, 3, 7], np.int64))
+    mn = jnp.asarray(np.array([4.0, 0.0, -2.0, 9.0]))
+    mx = jnp.asarray(np.array([4.0, 0.0, -2.0, 9.0]))
+    c = jnp.asarray(np.array([1.0, 0.0, 1.0, 1.0]))
+    keys, tables = pallas_scan.merge_sparse_tables(
+        uniq, [{"min": mn, "max": mx, "count": c}], 4,
+        [{"min": "min", "max": "max", "count": "add"}],
+    )
+    keys, t = np.asarray(keys), {f: np.asarray(v) for f, v in tables[0].items()}
+    present = keys != E
+    assert list(keys[present]) == [3, 7]
+    np.testing.assert_array_equal(t["min"][present], [-2.0, 9.0])
+    np.testing.assert_array_equal(t["max"][present], [4.0, 9.0])
+
+
+def test_merge_sparse_tables_order_trim():
+    """ORDER BY sum DESC LIMIT 2 keeps the top-2 groups, emitted in
+    ascending key order (executor decode contract)."""
+    E = int(pallas_scan.SPARSE_EMPTY_KEY)
+    uniq = jnp.asarray(np.array([5, 2, E, 2, 9, E, 5, 1], np.int64))
+    s = jnp.asarray(np.array([10, 1, 0, 2, 7, 0, 5, 3], np.float64))
+    c = jnp.asarray(np.array([2, 1, 0, 1, 1, 0, 1, 1], np.float64))
+    keys, tables = pallas_scan.merge_sparse_tables(
+        uniq, [{"sum": s, "count": c}], 2,
+        [{"sum": "add", "count": "add"}], order_spec=(0, "sum", False),
+    )
+    keys, t = np.asarray(keys), {f: np.asarray(v) for f, v in tables[0].items()}
+    assert list(keys) == [5, 9]  # sums 15 and 7: the DESC top-2, key-ascending
+    np.testing.assert_array_equal(t["sum"], [15, 7])
+
+
+# ---------------------------------------------------------------------------
+# engine-level routing
+# ---------------------------------------------------------------------------
+
+N = 1245 * 8
+
+
+def _bench_shaped_table(eng, *, seed=3):
+    """Mirror bench.py's lineorder: dict-encoded filter column with a range
+    index, so the whole WHERE compiles to one plain bitmap and the dense
+    kernel takes the word-fused path."""
+    rng = np.random.default_rng(seed)
+    schema = Schema(
+        "lineorder",
+        [
+            FieldSpec("lo_orderdate", DataType.INT),
+            FieldSpec("lo_quantity", DataType.INT),
+            FieldSpec("g", DataType.STRING),
+            FieldSpec("lo_revenue", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+    data = {
+        "lo_orderdate": (19920101 + rng.integers(0, 37, N)).astype(np.int32),
+        "lo_quantity": rng.integers(1, 51, N).astype(np.int32),
+        "g": np.asarray([f"g{i}" for i in rng.integers(0, 7, N)]),
+        "lo_revenue": rng.integers(-(10**9), 10**9, N).astype(np.int64),
+    }
+    cfg = TableConfig(
+        "lineorder", indexing=IndexingConfig(range_index_columns=["lo_quantity"])
+    )
+    eng.register_table(
+        "lineorder",
+        StackedTable.build(schema, data, eng.num_devices, table_config=cfg),
+    )
+    return data
+
+
+DENSE_Q = (
+    "SELECT lo_orderdate, SUM(lo_revenue), COUNT(*) FROM lineorder "
+    "WHERE lo_quantity < 25 GROUP BY lo_orderdate LIMIT 2500"
+)
+
+
+def _with_backend(monkeypatch, backend, **eng_kwargs):
+    monkeypatch.setenv("PINOT_TPU_SCAN_BACKEND", backend)
+    ops.scan_backend.cache_clear()
+    eng = DistributedEngine(**eng_kwargs)
+    _bench_shaped_table(eng)
+    return eng
+
+
+@pytest.fixture(autouse=True)
+def _reset_backend_cache():
+    yield
+    ops.scan_backend.cache_clear()
+
+
+def test_engine_word_fused_dense_routing(monkeypatch):
+    """The bench query rides the range-index bitmap on both backends and
+    returns identical rows, exact vs a pure-numpy reference."""
+    rows = {}
+    for be in ("xla", "interpret"):
+        eng = _with_backend(monkeypatch, be)
+        ctx = parse_query(DENSE_Q)
+        plan = eng._plan(ctx, eng.tables["lineorder"])
+        assert plan.row_sharded_params, "filter must ship bitmap words"
+        r = eng.execute(ctx)
+        assert ("lo_quantity", "range") in list(r.stats.filter_index_uses)
+        rows[be] = r.rows
+    assert rows["xla"] == rows["interpret"]
+
+    data = _bench_shaped_table(DistributedEngine())  # same seed: same rows
+    mask = data["lo_quantity"] < 25
+    ref = {}
+    for d, rv in zip(data["lo_orderdate"][mask], data["lo_revenue"][mask]):
+        s, c = ref.get(int(d), (0, 0))
+        ref[int(d)] = (s + int(rv), c + 1)
+    got = {int(r[0]): (int(r[1]), int(r[2])) for r in rows["interpret"]}
+    assert got == ref
+
+
+def test_engine_backend_in_plan_cache_key(monkeypatch):
+    """Switching backend must not reuse a plan traced for the other one."""
+    eng = _with_backend(monkeypatch, "xla")
+    ctx = parse_query(DENSE_Q)
+    p_xla = eng._plan(ctx, eng.tables["lineorder"])
+    monkeypatch.setenv("PINOT_TPU_SCAN_BACKEND", "interpret")
+    ops.scan_backend.cache_clear()
+    p_int = eng._plan(ctx, eng.tables["lineorder"])
+    assert p_xla is not p_int
+
+
+# maxDenseGroups=2 forces the sparse (fixed-slot hash table) plan at low
+# cardinality, same idiom as test_sparse_groupby.py
+SPARSE_Q = (
+    "SET maxDenseGroups = 2; SELECT g, SUM(lo_revenue), COUNT(*) FROM lineorder "
+    "GROUP BY g ORDER BY g LIMIT 10"
+)
+SPARSE_ORDER_Q = (
+    "SET maxDenseGroups = 2; SELECT g, SUM(lo_revenue) FROM lineorder GROUP BY g "
+    "ORDER BY SUM(lo_revenue) DESC LIMIT 3"
+)
+
+
+@pytest.mark.parametrize("query", [SPARSE_Q, SPARSE_ORDER_Q])
+def test_sparse_merge_on_device_across_batches(query):
+    """Macro-batched sparse group-by combines partial tables in-graph: the
+    trace shows a device merge span and NO host merge, and rows match the
+    single-launch engine exactly (including the ORDER BY ... LIMIT trim)."""
+    base = DistributedEngine()
+    _bench_shaped_table(base)
+    eng = DistributedEngine(launch_bytes=4096)  # force several launches
+    _bench_shaped_table(eng)
+
+    ctx = parse_query(query)
+    plan = eng._plan(ctx, eng.tables["lineorder"])
+    assert plan.kind == "groupby_sparse"
+    assert plan.sparse_merge_fn is not None
+    assert len(plan.batch_offsets) >= 2, "budget must force macro-batching"
+
+    ctx.options["trace"] = True
+    r = eng.execute(ctx)
+    spans = json.dumps(r.stats.trace)
+    assert "sparse_merge:device" in spans
+    assert "sparse_merge:host" not in spans
+    assert r.rows == base.query(query).rows
+
+
+def test_pipeline_depth_determinism(monkeypatch):
+    """Double-buffered launches (depth>1) must be byte-identical to the
+    sequential depth-1 schedule for every query kind."""
+    rows = {}
+    for depth in (1, 3):
+        monkeypatch.setenv("PINOT_TPU_PIPELINE_DEPTH", str(depth))
+        eng = DistributedEngine(launch_bytes=4096)
+        assert eng.pipeline_depth == depth
+        _bench_shaped_table(eng)
+        rows[depth] = [eng.query(q).rows for q in (DENSE_Q, SPARSE_Q, SPARSE_ORDER_Q)]
+    assert rows[1] == rows[3]

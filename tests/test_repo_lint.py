@@ -79,6 +79,57 @@ class TestW002HostSyncInKernel:
         assert _rules(src) == []
 
 
+class TestW002PallasKernelAndLaunchLoop:
+    def test_flags_host_numpy_inside_pallas_kernel_body(self):
+        src = """
+        import numpy as np
+        from jax.experimental import pallas as pl
+
+        def scan_kernel(x_ref, o_ref):
+            o_ref[...] = np.cumsum(x_ref[...])
+
+        def run(x):
+            return pl.pallas_call(scan_kernel, out_shape=x)(x)
+        """
+        assert _rules(src) == ["W002"]
+
+    def test_quiet_on_np_outside_kernel_body(self):
+        src = """
+        import numpy as np
+        from jax.experimental import pallas as pl
+
+        def scan_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def run(x):
+            shape = np.zeros(4)  # host setup around the launch is fine
+            return pl.pallas_call(scan_kernel, out_shape=x)(x)
+        """
+        assert _rules(src) == []
+
+    def test_flags_block_until_ready_in_launch_loop(self):
+        src = """
+        def run(fn, batches):
+            outs = []
+            for cols, params in batches:
+                outs.append(fn(cols, params).block_until_ready())
+            return outs
+        """
+        assert _rules(src) == ["W002"]
+
+    def test_quiet_on_hoisted_sync_and_device_get_in_loop(self):
+        src = """
+        import jax
+
+        def run(fn, batches):
+            outs = [fn(c, p) for c, p in batches]
+            for o in outs:
+                jax.device_get(o)  # fetch is a completion fence, not a stall
+            return outs[-1].block_until_ready()
+        """
+        assert _rules(src) == []
+
+
 class TestW003JitInLoop:
     def test_flags_jit_inside_loop_body(self):
         src = """

@@ -1,6 +1,6 @@
 """JAX-aware repo lint: ast pass over the pinot_tpu tree.
 
-Four rules, each targeting an anti-pattern this codebase has actually
+Six rules, each targeting an anti-pattern this codebase has actually
 been bitten by (ADVICE r5) or that silently degrades TPU throughput:
 
   W001 float-literal-in-jit   bare float literal used in arithmetic or a
@@ -20,6 +20,16 @@ been bitten by (ADVICE r5) or that silently degrades TPU throughput:
                               attribute in a cluster/ class method with no
                               enclosing `with <lock>:` — the exact broker
                               token-bucket race class from ADVICE r5.
+  W005 wall-clock-latency     time.time() used in elapsed-time math (a
+                              subtraction/comparison, directly or through a
+                              local alias) — deadlines, heartbeat staleness
+                              and latency measures must ride the monotonic
+                              clock or an NTP step mis-expires them.  Epoch
+                              *timestamps* (creationTimeMs etc.) are fine.
+  W006 swallowed-exception    an `except` handler in cluster/ whose body
+                              neither re-raises nor makes ANY call (no
+                              metrics/log/record) — faults on the serving
+                              path must be observable, never dropped.
 
 Kernel bodies (W001/W002 scope) are functions the module jits: decorated
 with @jax.jit / @partial(jax.jit, ...) or passed by name to jax.jit(...)
@@ -46,6 +56,8 @@ RULES: Dict[str, str] = {
     "W002": "host<->device sync inside jitted kernel",
     "W003": "jax.jit constructed per-iteration/per-call (recompiles)",
     "W004": "unlocked read-modify-write of shared state in cluster class",
+    "W005": "wall-clock time.time() in elapsed-time math (use monotonic/perf_counter)",
+    "W006": "except block in cluster/ swallows the exception without recording it",
 }
 
 _HOST_SYNC_ATTRS = frozenset({"item", "block_until_ready", "device_get", "tolist"})
@@ -342,8 +354,104 @@ def _check_w004(path: str, tree: ast.AST, findings: List[Finding]) -> None:
                             )
 
 
+def _is_time_time_call(node: ast.AST) -> bool:
+    """`time.time()` — the wall clock (bare `time()` is ambiguous, skipped)."""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "time"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "time"
+    )
+
+
+def _contains_time_time(node: ast.AST, aliases: Set[str]) -> bool:
+    for n in ast.walk(node):
+        if _is_time_time_call(n):
+            return True
+        if isinstance(n, ast.Name) and n.id in aliases:
+            return True
+    return False
+
+
+def _check_w005(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """Wall-clock elapsed-time math: time.time() (or a local assigned
+    exactly `time.time()`) used as an operand of a subtraction or
+    comparison.  `int(time.time() * 1000)` stored as an epoch timestamp is
+    deliberately NOT tracked through the alias — epoch math against data
+    timestamps (retention windows, segment time ranges) is correct use."""
+
+    def scope_nodes(body: List[ast.stmt]):
+        """Walk a scope without descending into nested function bodies
+        (those get their own pass with their own aliases)."""
+        stack: List[ast.AST] = list(body)
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # nested scope: gets its own pass with its own aliases
+            stack.extend(ast.iter_child_nodes(n))
+
+    def scan_scope(body: List[ast.stmt]) -> None:
+        aliases: Set[str] = set()
+        nodes = list(scope_nodes(body))
+        for n in nodes:  # collect aliases first: use can precede def in walk order
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and _is_time_time_call(n.value)
+            ):
+                aliases.add(n.targets[0].id)
+        if not aliases and not any(_is_time_time_call(n) for n in nodes):
+            return
+        for n in nodes:
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub):
+                if _contains_time_time(n.left, aliases) or _contains_time_time(n.right, aliases):
+                    findings.append(
+                        Finding(
+                            path, n.lineno, "W005",
+                            "time.time() in elapsed-time subtraction — use time.monotonic()/perf_counter()",
+                        )
+                    )
+            elif isinstance(n, ast.Compare):
+                if any(_contains_time_time(op, aliases) for op in [n.left] + list(n.comparators)):
+                    findings.append(
+                        Finding(
+                            path, n.lineno, "W005",
+                            "time.time() in a time comparison — use time.monotonic()/perf_counter()",
+                        )
+                    )
+
+    scan_scope(getattr(tree, "body", []))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_scope(node.body)
+
+
+def _check_w006(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """Swallowed exceptions: a handler with no Raise and no Call anywhere
+    in its body drops the fault invisibly (`except: pass`, `except:
+    continue`).  Any call — logging, metrics, recording onto a stats
+    object, even a send — counts as surfacing it."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        has_signal = any(
+            isinstance(n, (ast.Raise, ast.Call)) for n in ast.walk(ast.Module(body=node.body, type_ignores=[]))
+        )
+        if not has_signal:
+            findings.append(
+                Finding(
+                    path, node.lineno, "W006",
+                    "except block swallows the exception (no raise, no log/metrics/record call)",
+                )
+            )
+
+
 def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> List[Finding]:
-    """Lint one module's source.  `threaded` enables W004 (cluster/ scope)."""
+    """Lint one module's source.  `threaded` enables the cluster/-scoped
+    rules (W004 shared-state races, W006 swallowed exceptions)."""
     findings: List[Finding] = []
     try:
         tree = ast.parse(src)
@@ -363,8 +471,10 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
                 pallas_rules.visit(stmt)
     _check_w003(path, tree, findings)
     _check_sync_in_loop(path, tree, findings)
+    _check_w005(path, tree, findings)
     if threaded:
         _check_w004(path, tree, findings)
+        _check_w006(path, tree, findings)
     return findings
 
 

@@ -20,6 +20,21 @@ consistency) match the reference.
 """
 from pinot_tpu.cluster.coordinator import Coordinator
 from pinot_tpu.cluster.server import ServerInstance
-from pinot_tpu.cluster.broker import Broker
+from pinot_tpu.cluster.broker import (
+    Broker,
+    NoReplicaAvailableError,
+    ScatterGatherError,
+    ServerHealth,
+)
+from pinot_tpu.cluster.faults import FaultPlan, ServerFaultError
 
-__all__ = ["Coordinator", "ServerInstance", "Broker"]
+__all__ = [
+    "Coordinator",
+    "ServerInstance",
+    "Broker",
+    "ServerHealth",
+    "FaultPlan",
+    "ServerFaultError",
+    "NoReplicaAvailableError",
+    "ScatterGatherError",
+]

@@ -15,6 +15,7 @@ selection — matches the reference contracts.
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
@@ -23,12 +24,27 @@ from pinot_tpu.cluster.coordinator import Coordinator
 from pinot_tpu.query import reduce as reduce_mod
 from pinot_tpu.query.ir import FilterNode, FilterOp, PredicateType, QueryContext
 from pinot_tpu.query.result import ExecutionStats, ResultTable
+from pinot_tpu.query.safety import Deadline, QueryTimeoutError
 from pinot_tpu.utils.hashing import partition_of
+from pinot_tpu.utils.metrics import METRICS
 
 
 class QuotaExceededError(RuntimeError):
     """Per-table QPS quota hit (the reference returns 429 with
     BrokerErrorCode QUERY_QUOTA_EXCEEDED)."""
+
+
+class NoReplicaAvailableError(RuntimeError):
+    """A segment has no live replica left to route to (after exclusions)."""
+
+
+class ScatterGatherError(RuntimeError):
+    """A scatter call failed on every tried replica and the query did not
+    opt into allowPartialResults; carries the per-server exception list."""
+
+    def __init__(self, message: str, exceptions: Optional[List[Dict]] = None):
+        super().__init__(message)
+        self.exceptions = list(exceptions or [])
 
 
 class QueryQuotaManager:
@@ -100,6 +116,93 @@ class AdaptiveServerStats:
         lat = self.ewma_ms.get(server, 0.0)
         return lat * (1.0 + self.in_flight.get(server, 0))
 
+    def punish(self, server: str, factor: float = 2.0, floor_ms: float = 50.0) -> None:
+        """Failure feedback from the circuit-breaker path: a failed scatter
+        call counts as a slow response, so the adaptive selector sheds
+        traffic from flaky replicas BEFORE they trip quarantine."""
+        with self._lock:
+            prev = self.ewma_ms.get(server, 0.0)
+            self.ewma_ms[server] = max(prev * factor, floor_ms)
+
+
+class ServerHealth:
+    """Consecutive-failure circuit breaker over scatter targets
+    (the AdaptiveServerSelector "unhealthy server" shedding +
+    SERVER_NOT_RESPONDING handling collapsed into one explicit breaker).
+
+    States per server: CLOSED (healthy) -> OPEN after `failure_threshold`
+    consecutive scatter failures (quarantined: receives no routes while a
+    healthy replica exists) -> HALF_OPEN once `cooldown_s` elapses on the
+    monotonic clock (at most ONE in-flight probe query is allowed through;
+    success closes the breaker, failure re-opens it with a fresh cooldown).
+
+    Quarantine is advisory, never availability-destroying: when every
+    replica of a segment is quarantined the router still uses them (serving
+    a maybe-flaky replica beats failing the query outright)."""
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 30.0):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.clock = time.monotonic  # injectable for deterministic tests
+        self._lock = threading.Lock()
+        self._consecutive: Dict[str, int] = {}
+        self._opened_at: Dict[str, float] = {}  # server -> quarantine start
+        self._probing: Set[str] = set()  # half-open probes in flight
+
+    def record_failure(self, server: str) -> None:
+        with self._lock:
+            n = self._consecutive.get(server, 0) + 1
+            self._consecutive[server] = n
+            was_open = server in self._opened_at
+            self._probing.discard(server)
+            if n >= self.failure_threshold or was_open:
+                # threshold hit, or a half-open probe failed: (re-)quarantine
+                self._opened_at[server] = self.clock()
+                if not was_open:
+                    METRICS.counter("broker.serversQuarantined").inc()
+
+    def record_success(self, server: str) -> None:
+        with self._lock:
+            self._consecutive[server] = 0
+            if self._opened_at.pop(server, None) is not None:
+                METRICS.counter("broker.serversRecovered").inc()
+            self._probing.discard(server)
+
+    def state(self, server: str) -> str:
+        with self._lock:
+            t = self._opened_at.get(server)
+            if t is None:
+                return "closed"
+            return "half_open" if self.clock() - t >= self.cooldown_s else "open"
+
+    def available(self, server: str) -> bool:
+        """Routable right now?  CLOSED: yes.  OPEN: no.  HALF_OPEN: yes,
+        unless another probe is already in flight."""
+        with self._lock:
+            t = self._opened_at.get(server)
+            if t is None:
+                return True
+            if self.clock() - t < self.cooldown_s:
+                return False
+            return server not in self._probing
+
+    def begin_probe(self, server: str) -> None:
+        """Mark a routed call as the half-open probe (single-flight)."""
+        with self._lock:
+            if server in self._opened_at:
+                self._probing.add(server)
+
+    def consecutive_failures(self, server: str) -> int:
+        return self._consecutive.get(server, 0)
+
+    def reset(self, server: str) -> None:
+        """Fresh slate on a coordinator live-set recovery (mark_up): the
+        re-registered server is a new Helix session, not the flaky old one."""
+        with self._lock:
+            self._consecutive.pop(server, None)
+            self._opened_at.pop(server, None)
+            self._probing.discard(server)
+
 
 class Broker:
     def __init__(self, coordinator: Coordinator, selector: str = "balanced"):
@@ -109,18 +212,47 @@ class Broker:
         self._rr_lock = threading.Lock()  # cursor bump is an RMW across handler threads
         self.quota = QueryQuotaManager()
         self.server_stats = AdaptiveServerStats()
+        self.health = ServerHealth()
+        # failover backoff: injectable sleep + seeded jitter so fault tests
+        # are deterministic and never wall-clock sensitive
+        self.retry_rng = random.Random(0x5CA77E12)
+        self._sleep = time.sleep
+        coordinator.on_live_change(self._on_live_change)
+
+    def _on_live_change(self, name: str, up: bool) -> None:
+        """Coordinator live-set transition: a recovered server gets a fresh
+        breaker (a new Helix session is not the old flaky process)."""
+        if up:
+            self.health.reset(name)
 
     # -- routing table (built per query from the external view) -----------
-    def _route(self, table: str, seg_names: List[str]) -> Dict[str, List[str]]:
+    def _route(
+        self,
+        table: str,
+        seg_names: List[str],
+        exclude: frozenset = frozenset(),
+        partial_ok: bool = False,
+    ):
         """segment list -> {server: [segments]} picking ONE live replica per
-        segment (InstanceSelector contract)."""
+        segment (InstanceSelector contract).
+
+        `exclude`: servers that already failed this query (failover
+        re-selection never retries them).  Quarantined servers (ServerHealth
+        OPEN) are skipped while a healthy replica exists; when a segment's
+        every replica is quarantined, availability wins and they serve.
+        With partial_ok, returns (assign, unroutable_segments) instead of
+        raising on a replica-less segment."""
         view = self.coordinator.external_view(table)
+        healthy = {
+            s for s in self.coordinator.live if s not in exclude and self.health.available(s)
+        }
+        usable = {s for s in self.coordinator.live if s not in exclude}
         with self._rr_lock:
             self._rr += 1
         if self.selector == "replicagroup":
             # strict replica-group: pick ONE group serving ALL segments
             groups: Dict[int, Set[str]] = {}
-            for s in self.coordinator.live:
+            for s in healthy:
                 groups.setdefault(self.coordinator.replica_group[s], set()).add(s)
             order = sorted(groups)
             for gi in range(len(order)):
@@ -135,13 +267,18 @@ class Broker:
                         break
                     assign.setdefault(srv[0], []).append(seg)
                 if ok:
-                    return assign
+                    return (assign, []) if partial_ok else assign
             # no single group covers everything: fall through to balanced
         assign = {}
+        unroutable: List[str] = []
         for i, seg in enumerate(seg_names):
-            candidates = sorted(view.get(seg, ()))
+            replicas = view.get(seg, set())
+            candidates = sorted(replicas & healthy) or sorted(replicas & usable)
             if not candidates:
-                raise RuntimeError(f"segment {table}/{seg} has no live replica")
+                if partial_ok:
+                    unroutable.append(seg)
+                    continue
+                raise NoReplicaAvailableError(f"segment {table}/{seg} has no live replica")
             if self.selector == "adaptive":
                 # latency-biased: best (lowest) score wins; round-robin
                 # breaks exact ties so cold starts still spread
@@ -152,7 +289,7 @@ class Broker:
             else:
                 srv = candidates[(self._rr + i) % len(candidates)]
             assign.setdefault(srv, []).append(seg)
-        return assign
+        return (assign, unroutable) if partial_ok else assign
 
     # -- segment pruners ---------------------------------------------------
     def _prune(self, ctx: QueryContext, table: str) -> Tuple[List[str], int]:
@@ -211,8 +348,6 @@ class Broker:
         resolve_subqueries(ctx, _sub)
         if ctx.set_ops:
             return apply_set_ops(ctx, _sub)
-        from pinot_tpu.query.safety import Deadline
-
         t0 = time.perf_counter()
         deadline = Deadline.from_ctx(ctx)
         if ctx.joins:
@@ -250,24 +385,7 @@ class Broker:
         stats = ExecutionStats(num_segments_pruned=pruned)
         results = []
         if seg_names:
-            assign = self._route(table, seg_names)
-            # scatter-gather (QueryRouter.submitQuery analog, in-process)
-            for server_name, segs in assign.items():
-                deadline.check(f"query on {table}")
-                server = self.coordinator.servers[server_name]
-                self.server_stats.begin(server_name)
-                st0 = time.perf_counter()
-                try:
-                    res, sstats = server.execute(offline_ctx, segs, table_schema=meta.schema)
-                finally:
-                    self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
-                results.extend(res)
-                stats.num_segments_queried += sstats.num_segments_queried
-                stats.num_segments_processed += sstats.num_segments_processed
-                stats.num_segments_pruned += sstats.num_segments_pruned
-                stats.num_docs_scanned += sstats.num_docs_scanned
-                stats.total_docs += sstats.total_docs
-                stats.add_index_uses(sstats.filter_index_uses)
+            results.extend(self._scatter(offline_ctx, table, seg_names, meta, deadline, stats))
         # realtime tables: sealed + consuming segments served from the
         # coordinator-owned manager (the RealtimeTableDataManager view)
         rt = self.coordinator.realtime.get(table)
@@ -289,6 +407,140 @@ class Broker:
         out = reduce_mod.reduce_results(ctx, results, stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         return out
+
+    # -- fault-tolerant scatter-gather ------------------------------------
+    def _scatter(
+        self,
+        ctx: QueryContext,
+        table: str,
+        seg_names: List[str],
+        meta,
+        deadline: Deadline,
+        stats: ExecutionStats,
+    ) -> List:
+        """Deadline-budgeted scatter with replica failover (the
+        QueryRouter.submitQuery + BaseSingleStageBrokerRequestHandler retry
+        contract, in-process).
+
+        Each routed server gets the query's remaining budget, optionally
+        capped by the serverTimeoutMs option.  A failed or timed-out server
+        is excluded, trips the circuit breaker one notch, and its segments
+        re-route to surviving replicas (bounded rounds, jittered backoff).
+        When a segment has no replica left: with allowPartialResults=true
+        the response degrades (partialResult=true + exception entries +
+        numServersResponded < numServersQueried); otherwise the query fails
+        with the collected per-server exceptions."""
+        opts = ctx.options
+        allow_partial = str(opts.get("allowPartialResults", "")).lower() in ("1", "true", "yes")
+        max_retries = int(opts.get("maxScatterRetries", 2))
+        backoff_ms = float(opts.get("scatterBackoffMs", 2.0))
+        server_timeout_ms = opts.get("serverTimeoutMs")
+        results: List = []
+        excluded: Set[str] = set()
+        queried: Set[str] = set()
+        responded: Set[str] = set()
+        pending = list(seg_names)
+        rounds = 0
+        try:
+            while pending:
+                assign, unroutable = self._route(
+                    table, pending, exclude=frozenset(excluded), partial_ok=True
+                )
+                if unroutable:
+                    self._absorb_unroutable(table, unroutable, excluded, allow_partial, stats)
+                failed: List[str] = []
+                for server_name, segs in assign.items():
+                    deadline.check(f"query on {table}")
+                    server = self.coordinator.servers[server_name]
+                    queried.add(server_name)
+                    self.health.begin_probe(server_name)  # no-op unless half-open
+                    per_call = deadline.bounded(
+                        float(server_timeout_ms) if server_timeout_ms is not None else None
+                    )
+                    self.server_stats.begin(server_name)
+                    st0 = time.perf_counter()
+                    try:
+                        res, sstats = server.execute(
+                            ctx, segs, table_schema=meta.schema, deadline=per_call
+                        )
+                    except Exception as e:  # noqa: BLE001 — every fault is recorded below
+                        self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
+                        if isinstance(e, QueryTimeoutError) and deadline.expired():
+                            raise  # the QUERY is out of budget, not just this server
+                        self.server_stats.punish(server_name)
+                        self.health.record_failure(server_name)
+                        excluded.add(server_name)
+                        failed.extend(segs)
+                        stats.exceptions.append(
+                            {
+                                "errorCode": "EXECUTION_TIMEOUT_ERROR"
+                                if isinstance(e, QueryTimeoutError)
+                                else "SERVER_SCATTER_ERROR",
+                                "message": f"server {server_name}: {type(e).__name__}: {e}",
+                                "server": server_name,
+                            }
+                        )
+                        METRICS.counter("broker.scatterServerFailures").inc()
+                        continue
+                    self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
+                    self.health.record_success(server_name)
+                    responded.add(server_name)
+                    results.extend(res)
+                    stats.num_segments_queried += sstats.num_segments_queried
+                    stats.num_segments_processed += sstats.num_segments_processed
+                    stats.num_segments_pruned += sstats.num_segments_pruned
+                    stats.num_docs_scanned += sstats.num_docs_scanned
+                    stats.total_docs += sstats.total_docs
+                    stats.add_index_uses(sstats.filter_index_uses)
+                pending = failed
+                if pending:
+                    rounds += 1
+                    if rounds > max_retries:
+                        msg = (
+                            f"segments {sorted(pending)} of table {table!r} failed on every "
+                            f"tried replica after {max_retries} failover round(s)"
+                        )
+                        if not allow_partial:
+                            raise ScatterGatherError(msg, stats.exceptions)
+                        stats.partial_result = True
+                        stats.exceptions.append(
+                            {"errorCode": "PARTIAL_RESPONSE", "message": msg}
+                        )
+                        METRICS.counter("broker.partialResults").inc()
+                        break
+                    deadline.check(f"query on {table}")
+                    if backoff_ms > 0:
+                        # exponential backoff with full jitter (seeded rng)
+                        self._sleep(
+                            backoff_ms
+                            * (2 ** (rounds - 1))
+                            * (0.5 + self.retry_rng.random() / 2)
+                            / 1000.0
+                        )
+        finally:
+            stats.num_servers_queried = len(queried)
+            stats.num_servers_responded = len(responded)
+        return results
+
+    def _absorb_unroutable(
+        self,
+        table: str,
+        unroutable: List[str],
+        excluded: Set[str],
+        allow_partial: bool,
+        stats: ExecutionStats,
+    ) -> None:
+        """Segments with no routable replica: degrade to a partial result
+        when the query opted in, else fail with the routing detail."""
+        detail = f" (failed/excluded servers: {sorted(excluded)})" if excluded else ""
+        msg = (
+            f"segment(s) {sorted(unroutable)} of table {table!r} have no live replica{detail}"
+        )
+        if not allow_partial:
+            raise NoReplicaAvailableError(msg)
+        stats.partial_result = True
+        stats.exceptions.append({"errorCode": "NO_REPLICA_AVAILABLE", "message": msg})
+        METRICS.counter("broker.partialResults").inc()
 
     def _explain(self, ctx: QueryContext) -> ResultTable:
         """EXPLAIN PLAN FOR through the broker: reuse the engine explain

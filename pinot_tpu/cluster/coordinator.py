@@ -47,6 +47,26 @@ class Coordinator:
         # group assignment reads len(replica_group) then writes it: two
         # servers joining concurrently would land in the same group
         self._membership_lock = threading.Lock()
+        # live-set transition listeners: fn(server_name, is_up) — brokers
+        # subscribe so circuit-breaker state resets when a server recovers
+        self._live_listeners: List[Any] = []
+
+    def on_live_change(self, fn) -> None:
+        self._live_listeners.append(fn)
+
+    def _notify_live(self, name: str, up: bool) -> None:
+        import logging
+
+        from pinot_tpu.utils.metrics import METRICS
+
+        for fn in list(self._live_listeners):
+            try:
+                fn(name, up)
+            except Exception:  # noqa: BLE001 — one bad listener must not block transitions
+                METRICS.counter("liveListenerErrors").inc()
+                logging.getLogger("pinot_tpu.cluster").exception(
+                    "live-set listener failed for %s", name
+                )
 
     # -- instance lifecycle (Helix participant analog) -------------------
     def register_server(self, server) -> None:
@@ -58,11 +78,14 @@ class Coordinator:
     def mark_down(self, name: str) -> None:
         """Liveness loss (Helix session expiry analog): external view drops
         the server; ideal state keeps it until rebalance repairs."""
-        self.live.discard(name)
+        if name in self.live:
+            self.live.discard(name)
+            self._notify_live(name, up=False)
 
     def mark_up(self, name: str) -> None:
-        if name in self.servers:
+        if name in self.servers and name not in self.live:
             self.live.add(name)
+            self._notify_live(name, up=True)
 
     # -- table CRUD ------------------------------------------------------
     def add_table(self, schema: Schema, config: Optional[TableConfig] = None) -> None:
@@ -235,17 +258,18 @@ class Coordinator:
     def heartbeat(self, server_name: str) -> None:
         """Servers call this periodically; check_liveness marks stale ones
         down (the failure-DETECTION half of SURVEY §5.3 — rebalance is the
-        recovery half)."""
+        recovery half).  Staleness is measured on the monotonic clock: an
+        NTP step on the wall clock must never mass-expire the fleet."""
         if not hasattr(self, "_heartbeats"):
             self._heartbeats: Dict[str, float] = {}
-        self._heartbeats[server_name] = time.time()
+        self._heartbeats[server_name] = time.monotonic()
         # a recovered server resumes serving (Helix session re-establishment)
         if server_name in self.servers and server_name not in self.live:
             self.mark_up(server_name)
 
     def check_liveness(self, timeout_s: float = 30.0) -> List[str]:
         """Mark servers with stale heartbeats down; returns who was dropped."""
-        now = time.time()
+        now = time.monotonic()
         dropped = []
         for name in list(self.live):
             hb = getattr(self, "_heartbeats", {}).get(name)

@@ -1,0 +1,142 @@
+"""Deterministic fault injection for the cluster layer.
+
+Reference parity: Pinot exercises its failover paths with integration tests
+that kill servers mid-query (e.g. OfflineGRPCServerIntegrationTest /
+ServerStarter restarts); here the same chaos is scripted as data.  A
+FaultPlan is a seeded, reproducible schedule of faults keyed by (server,
+call number): fail server S on its Nth scatter call, add fixed latency,
+drop a segment from its local view, flap coordinator liveness.  Hooks live
+in ServerInstance.execute (on_execute / segment_dropped) and the
+coordinator (mark_down / mark_up via flap rules), so every
+failover/quarantine/partial-result path in the broker is driven by tier-1
+tests instead of hoped-for.
+
+Determinism contract: the same plan (same seed, same builder calls) applied
+to an identically-built cluster produces the same fault sequence, hence the
+same BrokerResponse — asserted by tests/test_fault_tolerance.py.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class ServerFaultError(RuntimeError):
+    """Injected server-side failure — the harness' stand-in for a crashed or
+    unreachable server (the broker must treat it like any transport error)."""
+
+
+@dataclass
+class _Rule:
+    kind: str  # "fail" | "latency" | "flap_down" | "flap_up"
+    trigger: str  # server whose call counter drives the rule
+    target: str  # server the effect applies to (== trigger for fail/latency)
+    calls: Optional[Set[int]] = None  # 1-based call numbers; None = every call
+    ms: float = 0.0
+    message: str = ""
+
+
+# fail raises, so side-effecting rules on the same call apply first
+_APPLY_ORDER = {"latency": 0, "flap_down": 1, "flap_up": 1, "fail": 2}
+
+
+class FaultPlan:
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.sleep = time.sleep  # injectable for clock-free tests
+        self.log: List[Tuple] = []  # (server, call_n, kind, detail) as applied
+        self._rules: List[_Rule] = []
+        self._dropped: Set[Tuple[str, str, str]] = set()  # (server, table, segment)
+        self._calls: Dict[str, int] = {}
+        self._coordinator = None
+        self._lock = threading.Lock()
+
+    # -- wiring ----------------------------------------------------------
+    def attach(self, coordinator) -> "FaultPlan":
+        """Install the plan into every registered server + the coordinator
+        (servers registered later can be given `server.fault_plan = plan`)."""
+        self._coordinator = coordinator
+        for s in coordinator.servers.values():
+            s.fault_plan = self
+        return self
+
+    # -- plan builders (chainable) ----------------------------------------
+    def fail_server(self, server: str, on_call: int = 1, times: int = 1, message: str = "") -> "FaultPlan":
+        """Raise ServerFaultError on the server's Nth..N+times-1th execute."""
+        self._rules.append(
+            _Rule("fail", server, server, calls=set(range(on_call, on_call + times)), message=message)
+        )
+        return self
+
+    def always_fail(self, server: str, message: str = "") -> "FaultPlan":
+        self._rules.append(_Rule("fail", server, server, calls=None, message=message))
+        return self
+
+    def add_latency(self, server: str, ms: float, on_call: Optional[int] = None) -> "FaultPlan":
+        """Sleep `ms` at the top of the server's execute (every call when
+        on_call is None) — the slow-replica / network-delay fault."""
+        calls = None if on_call is None else {on_call}
+        self._rules.append(_Rule("latency", server, server, calls=calls, ms=ms))
+        return self
+
+    def drop_segment(self, server: str, table: str, segment: str) -> "FaultPlan":
+        """The server behaves as if it never downloaded the segment (a lost
+        local copy); routing there fails with KeyError and must fail over."""
+        self._dropped.add((server, table, segment))
+        return self
+
+    def flap_down(self, server: str, on_call: int = 1, of: Optional[str] = None) -> "FaultPlan":
+        """Mark `server` down in the coordinator when `of` (default: the
+        server itself) receives its Nth call — mid-scatter liveness loss."""
+        self._rules.append(_Rule("flap_down", of or server, server, calls={on_call}))
+        return self
+
+    def flap_up(self, server: str, on_call: int, of: Optional[str] = None) -> "FaultPlan":
+        self._rules.append(_Rule("flap_up", of or server, server, calls={on_call}))
+        return self
+
+    def chaos(self, servers: List[str], p_fail: float, max_calls: int = 8) -> "FaultPlan":
+        """Seeded random failures: each (server, call<=max_calls) fails with
+        probability p_fail, drawn ONCE at plan-build time from the plan's
+        rng — two plans with the same seed script identical chaos."""
+        for s in servers:
+            bad = {n for n in range(1, max_calls + 1) if self.rng.random() < p_fail}
+            if bad:
+                self._rules.append(_Rule("fail", s, s, calls=bad, message="chaos"))
+        return self
+
+    # -- runtime hooks (called from ServerInstance.execute) ----------------
+    def on_execute(self, server_name: str) -> None:
+        with self._lock:
+            n = self._calls[server_name] = self._calls.get(server_name, 0) + 1
+            due = [
+                r
+                for r in self._rules
+                if r.trigger == server_name and (r.calls is None or n in r.calls)
+            ]
+        for r in sorted(due, key=lambda r: _APPLY_ORDER[r.kind]):
+            self.log.append((server_name, n, r.kind, r.target))
+            if r.kind == "latency":
+                self.sleep(r.ms / 1000.0)
+            elif r.kind == "flap_down" and self._coordinator is not None:
+                self._coordinator.mark_down(r.target)
+            elif r.kind == "flap_up" and self._coordinator is not None:
+                self._coordinator.mark_up(r.target)
+            elif r.kind == "fail":
+                raise ServerFaultError(
+                    r.message or f"injected fault: server {server_name} died (call {n})"
+                )
+
+    def segment_dropped(self, server: str, table: str, segment: str) -> bool:
+        if (server, table, segment) in self._dropped:
+            self.log.append((server, self._calls.get(server, 0), "drop_segment", segment))
+            return True
+        return False
+
+    def calls(self, server: str) -> int:
+        """How many execute calls the server has received under this plan."""
+        return self._calls.get(server, 0)

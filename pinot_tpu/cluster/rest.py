@@ -50,7 +50,11 @@ def broker_response(result: ResultTable) -> Dict[str, Any]:
         "totalDocs": s.total_docs,
         "timeUsedMs": round(s.time_ms, 3),
         "trace": s.trace,
-        "exceptions": [],
+        # fault surface (BrokerResponse partialResult / processingExceptions)
+        "partialResult": bool(s.partial_result),
+        "exceptions": list(s.exceptions),
+        "numServersQueried": s.num_servers_queried,
+        "numServersResponded": s.num_servers_responded,
     }
 
 
@@ -112,12 +116,43 @@ class QueryServer:
                     self._send(200, payload)
                 except Exception as e:  # noqa: BLE001 - boundary
                     from pinot_tpu.analysis.plan_check import PlanCheckError
-                    from pinot_tpu.cluster.broker import QuotaExceededError
+                    from pinot_tpu.cluster.broker import (
+                        NoReplicaAvailableError,
+                        QuotaExceededError,
+                        ScatterGatherError,
+                    )
+                    from pinot_tpu.query.safety import AdmissionError, QueryTimeoutError
 
                     if isinstance(e, QuotaExceededError):
                         # the reference's 429 QUERY_QUOTA_EXCEEDED contract:
                         # throttled clients must be able to back off
                         self._send(429, {"error": str(e), "errorCode": "QUERY_QUOTA_EXCEEDED"})
+                    elif isinstance(e, QueryTimeoutError):
+                        # deadline blew anywhere in the scatter: 408, the
+                        # reference's EXECUTION_TIMEOUT_ERROR contract
+                        self._send(408, {"error": str(e), "errorCode": "EXECUTION_TIMEOUT_ERROR"})
+                    elif isinstance(e, AdmissionError):
+                        # resource admission refused up-front: retryable 503
+                        self._send(
+                            503,
+                            {"error": str(e), "errorCode": "SERVER_RESOURCE_LIMIT_EXCEEDED"},
+                        )
+                    elif isinstance(e, ScatterGatherError):
+                        # every replica of some segment failed and the query
+                        # did not allow partial results
+                        self._send(
+                            500,
+                            {
+                                "error": str(e),
+                                "errorCode": "SERVER_SCATTER_ERROR",
+                                "exceptions": e.exceptions,
+                            },
+                        )
+                    elif isinstance(e, NoReplicaAvailableError):
+                        # a segment lost every live replica: retryable 503
+                        # (capacity may come back), distinct from scatter
+                        # failures so clients can tell "down" from "flaky"
+                        self._send(503, {"error": str(e), "errorCode": "NO_REPLICA_AVAILABLE"})
                     elif isinstance(e, PlanCheckError):
                         # statically-rejected plan: a 400 with the machine
                         # code, never a tracer traceback (analysis/plan_check)

@@ -9,23 +9,35 @@ Re-design: segments stay the same ImmutableSegment objects (in one process
 the "download from deep store" step is a reference share / mmap re-open);
 execution reuses the SSE executor with its device pytree cache, so each
 logical server keeps its own HBM-resident working set.
+
+Fault surface: the broker hands each scatter call a Deadline (its remaining
+budget, optionally capped by serverTimeoutMs) — the launch/collect loop
+checks it between kernels, and on expiry abandons still-pending launches
+(cooperative cancellation: JAX dispatch is async, so "cancel" means never
+collecting — no device_get, no host sync) before raising QueryTimeoutError.
+An attached cluster.faults.FaultPlan can fail/delay the call or hide
+segments, driving the broker's failover paths deterministically.
 """
 from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from pinot_tpu.query import executor, reduce as reduce_mod
+from pinot_tpu.query import executor
 from pinot_tpu.query.ir import QueryContext
 from pinot_tpu.query.result import ExecutionStats
+from pinot_tpu.query.safety import Deadline, QueryTimeoutError
 from pinot_tpu.segment.segment import ImmutableSegment
+from pinot_tpu.utils.metrics import METRICS
 
 
 class ServerInstance:
-    def __init__(self, name: str, device=None):
+    def __init__(self, name: str, device=None, fault_plan=None):
         self.name = name
         self.device = device
         # table -> {segment name -> segment}
         self.segments: Dict[str, Dict[str, ImmutableSegment]] = {}
+        # cluster.faults.FaultPlan hook (None in production)
+        self.fault_plan = fault_plan
 
     # -- data manager ----------------------------------------------------
     def add_segment(self, table: str, segment: ImmutableSegment) -> None:
@@ -41,16 +53,28 @@ class ServerInstance:
         return list(self.segments.get(table, {}))
 
     # -- query execution (InstanceRequestHandler analog) ------------------
-    def execute(self, ctx: QueryContext, seg_names: List[str], table_schema=None):
+    def execute(
+        self,
+        ctx: QueryContext,
+        seg_names: List[str],
+        table_schema=None,
+        deadline: Optional[Deadline] = None,
+    ):
         """Run one query over the named LOCAL segments; returns
         (segment results, stats) — the DataTable the reference ships back."""
         from pinot_tpu.query.planner import _needed_columns
 
+        plan = self.fault_plan
+        if plan is not None:
+            plan.on_execute(self.name)  # may sleep, flap liveness, or raise
         stats = ExecutionStats()
         results = []
         pending = []
         for name in seg_names:
+            self._check_budget(deadline, cancelled=len(pending))
             seg = self.get_segment(ctx.table, name)
+            if seg is not None and plan is not None and plan.segment_dropped(self.name, ctx.table, name):
+                seg = None
             if seg is None:
                 raise KeyError(f"server {self.name} does not serve {ctx.table}/{name}")
             stats.num_segments_queried += 1
@@ -62,10 +86,24 @@ class ServerInstance:
                 continue
             # pipelined: dispatch all kernels async, then drain (executor.py)
             pending.append(executor.launch_segment(ctx, seg, device=self.device))
-        for st in pending:
+        for i, st in enumerate(pending):
+            self._check_budget(deadline, cancelled=len(pending) - i)
             res, seg_stats = executor.collect_segment(st)
             stats.num_segments_processed += 1
             stats.num_docs_scanned += seg_stats.num_docs_scanned
             stats.add_index_uses(seg_stats.filter_index_uses)
             results.append(res)
         return results, stats
+
+    def _check_budget(self, deadline: Optional[Deadline], cancelled: int) -> None:
+        """Between-kernel deadline check.  On expiry the still-pending
+        launches are abandoned uncollected (their references die with this
+        frame — the async dispatches finish on device but never sync back)."""
+        if deadline is not None and deadline.expired():
+            if cancelled:
+                METRICS.counter("server.launchesCancelled").inc(cancelled)
+            raise QueryTimeoutError(
+                f"server {self.name} ran out of query budget "
+                f"(timeoutMs={deadline.timeout_ms:g}); "
+                f"{cancelled} pending launch(es) abandoned"
+            )

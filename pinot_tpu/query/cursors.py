@@ -26,7 +26,8 @@ class ResponseStore:
         cid = uuid.uuid4().hex[:16]
         with self._lock:
             self._evict_locked()
-            self._store[cid] = (result, max(1, page_size), time.time())
+            # monotonic: TTL age math must not jump with wall-clock steps
+            self._store[cid] = (result, max(1, page_size), time.monotonic())
         return cid
 
     def fetch(self, cursor_id: str, page: int) -> Dict:
@@ -54,7 +55,7 @@ class ResponseStore:
             return self._store.pop(cursor_id, None) is not None
 
     def _evict_locked(self) -> None:
-        now = time.time()
+        now = time.monotonic()
         dead = [cid for cid, (_, _, t) in self._store.items() if now - t > self.ttl]
         for cid in dead:
             del self._store[cid]

@@ -26,6 +26,14 @@ class ExecutionStats:
     total_docs: int = 0
     num_groups: int = 0
     time_ms: float = 0.0
+    # scatter-gather fault surface (BrokerResponse partialResult /
+    # processingExceptions / numServersQueried|Responded analog): a query
+    # that lost segments but was allowed to degrade carries
+    # partial_result=True plus one exception entry per absorbed failure
+    num_servers_queried: int = 0
+    num_servers_responded: int = 0
+    partial_result: bool = False
+    exceptions: List[Dict[str, Any]] = field(default_factory=list)
     # (column, "sorted"|"range"|"inverted") per index-accelerated predicate —
     # proof that the filter read bitmap/doc-range rows instead of scanning
     # codes (BitmapBasedFilterOperator analog; see query/filter.py)
@@ -40,6 +48,10 @@ class ExecutionStats:
         self.num_docs_scanned += other.num_docs_scanned
         self.total_docs += other.total_docs
         self.num_groups = max(self.num_groups, other.num_groups)
+        self.num_servers_queried += other.num_servers_queried
+        self.num_servers_responded += other.num_servers_responded
+        self.partial_result = self.partial_result or other.partial_result
+        self.exceptions.extend(other.exceptions)
         self.add_index_uses(other.filter_index_uses)
 
     def add_index_uses(self, uses: Tuple) -> None:
@@ -120,4 +132,8 @@ class ResultTable:
             "numDocsScanned": self.stats.num_docs_scanned,
             "totalDocs": self.stats.total_docs,
             "timeUsedMs": self.stats.time_ms,
+            "numServersQueried": self.stats.num_servers_queried,
+            "numServersResponded": self.stats.num_servers_responded,
+            "partialResult": self.stats.partial_result,
+            "exceptions": list(self.stats.exceptions),
         }

@@ -36,7 +36,11 @@ class Deadline:
 
     def __init__(self, timeout_ms: Optional[float]):
         self.timeout_ms = timeout_ms
-        self.expires_at = time.perf_counter() + timeout_ms / 1000 if timeout_ms else None
+        # `timeout_ms == 0` is an ALREADY-EXPIRED deadline, not "no deadline"
+        # (a truthiness check here used to silently disable it)
+        self.expires_at = (
+            time.perf_counter() + timeout_ms / 1000 if timeout_ms is not None else None
+        )
 
     @staticmethod
     def from_ctx(ctx: QueryContext) -> "Deadline":
@@ -44,8 +48,25 @@ class Deadline:
         return Deadline(float(t) if t is not None else None)
 
     def check(self, what: str = "query") -> None:
-        if self.expires_at is not None and time.perf_counter() > self.expires_at:
+        if self.expired():
             raise QueryTimeoutError(f"{what} exceeded timeoutMs={self.timeout_ms:g}")
+
+    def expired(self) -> bool:
+        return self.expires_at is not None and time.perf_counter() >= self.expires_at
+
+    def remaining_ms(self) -> Optional[float]:
+        """Budget left, in ms; None = unbounded."""
+        if self.expires_at is None:
+            return None
+        return max(0.0, (self.expires_at - time.perf_counter()) * 1000)
+
+    def bounded(self, timeout_ms: Optional[float]) -> "Deadline":
+        """A child deadline capped at min(this deadline, timeout_ms) — the
+        per-server budget the broker hands each scatter call."""
+        rem = self.remaining_ms()
+        if timeout_ms is None:
+            return self if rem is None else Deadline(rem)
+        return Deadline(min(rem, float(timeout_ms)) if rem is not None else float(timeout_ms))
 
 
 def estimate_segment_bytes(ctx: QueryContext, segment, needed_columns: Optional[List[str]] = None) -> int:

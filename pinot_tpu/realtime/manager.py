@@ -52,7 +52,8 @@ class RealtimeSegmentDataManager:
         self.consumer = consumer
         self.offset = start_offset
         self.seq = seq
-        self.segment_start_ms = time.time() * 1000
+        # monotonic: segment age (seal criteria) is an elapsed-time measure
+        self.segment_start_ms = time.monotonic() * 1000
         self.mutable = MutableSegment(
             table.schema,
             segment_name(table.config.name, partition, seq),
@@ -102,7 +103,7 @@ class RealtimeSegmentDataManager:
             return False
         if self.mutable.num_docs >= cfg.max_rows_per_segment:
             return True
-        age_s = (time.time() * 1000 - self.segment_start_ms) / 1000
+        age_s = (time.monotonic() * 1000 - self.segment_start_ms) / 1000
         return self.mutable.num_docs > 0 and age_s >= cfg.max_segment_seconds
 
     # -- commit ----------------------------------------------------------
@@ -116,7 +117,7 @@ class RealtimeSegmentDataManager:
         self.table._swap_in(self.partition, sealed)
         self.seq += 1
         self.table._commit_checkpoint(self.partition, self.offset, self.seq)
-        self.segment_start_ms = time.time() * 1000
+        self.segment_start_ms = time.monotonic() * 1000
         self.mutable = MutableSegment(
             self.table.schema,
             segment_name(self.table.config.name, self.partition, self.seq),

@@ -103,6 +103,23 @@ class TestQueryQuota:
             q.check("t", 0.5, now=101.0)  # only 1s elapsed: 0.5 tokens
         q.check("t", 0.5, now=102.1)  # 2.1s since success: ~1.05 tokens
 
+    def test_fractional_quota_refill_via_injectable_clock(self):
+        """Same contract through the clock the broker path uses (no `now=`):
+        q=0.5 admits exactly one query per 2-second window."""
+        clk = [100.0]
+        q = QueryQuotaManager()
+        q.clock = lambda: clk[0]
+        q.check("t", 0.5)
+        admitted = 1
+        for _ in range(40):  # walk 10s in 0.25s steps
+            clk[0] += 0.25
+            try:
+                q.check("t", 0.5)
+                admitted += 1
+            except QuotaExceededError:
+                pass
+        assert admitted == 1 + 5  # one per 2s over the 10s walk
+
 
 class TestAdaptiveSelection:
     def test_scores_prefer_fast_idle_servers(self):
@@ -135,6 +152,55 @@ class TestAdaptiveSelection:
         r = broker.query("SELECT COUNT(*) FROM t")
         assert int(r.rows[0][0]) == 200
         assert broker.server_stats.ewma_ms["server1"] != 2.0  # updated
+
+
+class TestAdaptiveStatsConcurrency:
+    def test_begin_end_under_concurrent_threads(self):
+        """begin/end are read-modify-writes: unlocked, two begins could both
+        read in_flight=0 (count lost -> later end drives it negative) and
+        EWMA decay updates could vanish.  Hammer one shared server from many
+        threads and verify the invariants hold."""
+        import threading
+
+        st = AdaptiveServerStats()
+        n_threads, n_iter = 8, 500
+        errors = []
+
+        def worker(tid):
+            try:
+                for i in range(n_iter):
+                    st.begin("shared")
+                    st.end("shared", float((tid * n_iter + i) % 37) + 1.0)
+                    # per-thread server: its EWMA entry must never be lost
+                    st.begin(f"srv{tid}")
+                    st.end(f"srv{tid}", 10.0 * (tid + 1))
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # every begin was paired with an end: in-flight settles at exactly 0
+        assert st.in_flight["shared"] == 0
+        assert all(st.in_flight[f"srv{t}"] == 0 for t in range(n_threads))
+        # no lost dict updates: every per-thread server kept its EWMA (each
+        # thread always reports the same latency, so EWMA == that latency)
+        for t in range(n_threads):
+            assert st.ewma_ms[f"srv{t}"] == pytest.approx(10.0 * (t + 1))
+        assert st.ewma_ms["shared"] > 0.0
+
+    def test_punish_inflates_score(self):
+        st = AdaptiveServerStats()
+        st.begin("s"); st.end("s", 4.0)
+        before = st.score("s")
+        st.punish("s")
+        assert st.score("s") >= max(2 * before, 50.0)
+        # repeated punishment keeps compounding (flaky stays deprioritized)
+        st.punish("s")
+        assert st.ewma_ms["s"] == pytest.approx(100.0)
 
 
 class TestUpsertCompaction:

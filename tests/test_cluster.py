@@ -286,7 +286,7 @@ class TestPeriodicTasks:
             coord.add_segment("t", build_segment(_schema(), _data(200, seed=70 + i), f"seg{i}"))
         for s in coord.servers:
             coord.heartbeat(s)
-        coord._heartbeats["server2"] = _time.time() - 120  # stale
+        coord._heartbeats["server2"] = _time.monotonic() - 120  # stale
         report = coord.run_periodic_tasks(heartbeat_timeout_s=30)
         assert report["serversDropped"] == ["server2"]
         assert "t" in report["tablesRebalanced"]

@@ -1,0 +1,417 @@
+"""Fault-tolerant scatter-gather tests: replica failover, circuit breaker,
+partial results, deadline propagation, REST error-code parity — all driven
+by the deterministic cluster.faults.FaultPlan harness (no sleeps, no luck).
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import (
+    Broker,
+    Coordinator,
+    FaultPlan,
+    NoReplicaAvailableError,
+    ServerFaultError,
+    ServerHealth,
+    ServerInstance,
+)
+from pinot_tpu.query.safety import Deadline, QueryTimeoutError
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.config import SegmentsConfig, TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+from golden import assert_same_rows, sqlite_from_data
+
+
+def _schema():
+    return Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+
+
+def _data(n, seed, t0=1_700_000_000_000):
+    rng = np.random.default_rng(seed)
+    return {
+        "city": rng.choice(["sf", "nyc", "la"], n).astype(object),
+        "v": rng.integers(0, 100, n),
+        "ts": t0 + rng.integers(0, 86_400_000, n).astype(np.int64),
+    }
+
+
+def _cluster(n_servers=3, replication=2, n_segments=4, rows=300):
+    """Deterministic cluster: same args -> identical assignment + data."""
+    coord = Coordinator(replication=replication)
+    for i in range(n_servers):
+        coord.register_server(ServerInstance(f"server{i}"))
+    coord.add_table(_schema(), TableConfig(name="t", segments=SegmentsConfig(time_column="ts")))
+    datas = []
+    for i in range(n_segments):
+        d = _data(rows, seed=100 + i)
+        datas.append(d)
+        coord.add_segment("t", build_segment(_schema(), d, f"seg{i}"))
+    merged = {k: np.concatenate([d[k] for d in datas]) for k in datas[0]}
+    return coord, merged
+
+
+QUERIES = [
+    "SELECT COUNT(*), SUM(v) FROM t",
+    "SELECT city, COUNT(*), SUM(v) FROM t GROUP BY city ORDER BY city",
+]
+
+
+class TestDeadlineRegression:
+    def test_zero_timeout_is_already_expired(self):
+        """timeoutMs=0 used to be falsy and silently DISABLED the deadline."""
+        d = Deadline(0)
+        assert d.expired()
+        with pytest.raises(QueryTimeoutError, match="timeoutMs=0"):
+            d.check()
+
+    def test_none_never_expires(self):
+        d = Deadline(None)
+        assert not d.expired()
+        assert d.remaining_ms() is None
+        d.check()
+
+    def test_bounded_child_deadline(self):
+        parent = Deadline(60_000)
+        child = parent.bounded(10.0)
+        assert child.remaining_ms() <= 10.0
+        # unbounded parent + cap -> cap; unbounded both -> unbounded
+        assert Deadline(None).bounded(5.0).timeout_ms == 5.0
+        assert Deadline(None).bounded(None).remaining_ms() is None
+
+
+class TestReplicaFailover:
+    def test_server_killed_mid_scatter_exact_rows(self):
+        """A seeded FaultPlan kills a server on its first scatter call; the
+        broker re-routes its segments to surviving replicas and the result
+        matches the no-fault run exactly."""
+        # replication == n_servers: every segment lives on both servers, so
+        # server0 is routed some segments in EVERY query (deterministic kill)
+        coord_ok, merged = _cluster(n_servers=2, replication=2)
+        baseline = {sql: Broker(coord_ok).query(sql).rows for sql in QUERIES}
+        conn = sqlite_from_data("t", merged)
+
+        coord, _ = _cluster(n_servers=2, replication=2)
+        plan = FaultPlan(seed=7).fail_server("server0", on_call=1).attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None  # no real backoff waits in tests
+        for sql in QUERIES:
+            res = broker.query(sql)
+            assert_same_rows(res.rows, baseline[sql])
+            assert_same_rows(res.rows, conn.execute(sql).fetchall())
+            # failover absorbed the crash: never partial, never zero servers
+            assert res.stats.partial_result is False
+            assert res.stats.num_servers_responded >= 1
+        # the injected kill actually fired and was recorded
+        assert any(entry[2] == "fail" for entry in plan.log)
+        assert plan.calls("server0") >= 1
+
+    def test_dropped_segment_fails_over(self):
+        """A server that lost a local segment copy (KeyError) triggers
+        failover for just that server's segments."""
+        coord_ok, merged = _cluster()
+        baseline = Broker(coord_ok).query(QUERIES[0]).rows
+        coord, _ = _cluster()
+        FaultPlan(seed=3).drop_segment("server0", "t", "seg0").attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        for _ in range(4):  # whatever replica rotation picks server0 for seg0
+            res = broker.query(QUERIES[0])
+            assert_same_rows(res.rows, baseline)
+
+    def test_chaos_is_deterministic(self):
+        """Two identically-seeded plans on identically-built clusters produce
+        byte-identical responses — the reproducibility contract."""
+
+        def run(seed):
+            coord, _ = _cluster(n_servers=4, replication=2, n_segments=6)
+            FaultPlan(seed=seed).chaos([f"server{i}" for i in range(4)], p_fail=0.4).attach(coord)
+            broker = Broker(coord)
+            broker._sleep = lambda s: None
+            res = broker.query(
+                "SET allowPartialResults = true; SELECT city, COUNT(*), SUM(v) FROM t GROUP BY city ORDER BY city"
+            )
+            return res.rows, res.stats.partial_result, len(res.stats.exceptions)
+
+        assert run(1234) == run(1234)
+
+    def test_failover_exhaustion_raises_without_partial_optin(self):
+        coord, _ = _cluster(n_servers=2, replication=1, n_segments=2)
+        FaultPlan(seed=1).always_fail("server0").always_fail("server1").attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        with pytest.raises(RuntimeError, match="no live replica|failed on every"):
+            broker.query(QUERIES[0])
+
+
+class TestPartialResults:
+    def _partial_cluster(self):
+        """replication=1, one server permanently dead: its segments have no
+        surviving replica, the other server's segments still answer."""
+        coord, merged = _cluster(n_servers=2, replication=1, n_segments=4)
+        FaultPlan(seed=11).always_fail("server0", message="injected crash").attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        return coord, broker
+
+    def test_partial_response_metadata(self):
+        coord, broker = self._partial_cluster()
+        res = broker.query("SET allowPartialResults = true; SELECT COUNT(*) FROM t")
+        s = res.stats
+        assert s.partial_result is True
+        assert s.exceptions and any("server0" in str(e) for e in s.exceptions)
+        assert s.num_servers_responded < s.num_servers_queried
+        # surviving segments' rows are complete and correct
+        live_docs = sum(
+            seg.num_docs for seg in coord.servers["server1"].segments["t"].values()
+        )
+        assert int(res.rows[0][0]) == live_docs > 0
+
+    def test_without_optin_raises_cleanly(self):
+        _, broker = self._partial_cluster()
+        with pytest.raises(RuntimeError, match="no live replica"):
+            broker.query("SELECT COUNT(*) FROM t")
+
+    def test_all_replicas_marked_down(self):
+        """Liveness-down (not crash) replicas: partialResult path through
+        unroutable segments."""
+        coord, _ = _cluster(n_servers=2, replication=1, n_segments=4)
+        # kill server0 mid-scatter via a flap triggered by server1's call,
+        # so server0 was queried (and fails), then has no live replica left
+        plan = FaultPlan(seed=5)
+        plan.always_fail("server0").flap_down("server0", on_call=1, of="server1").attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        res = broker.query("SET allowPartialResults = true; SELECT COUNT(*) FROM t")
+        assert res.stats.partial_result is True
+        assert any(e["errorCode"] == "NO_REPLICA_AVAILABLE" for e in res.stats.exceptions) or any(
+            e["errorCode"] == "PARTIAL_RESPONSE" for e in res.stats.exceptions
+        )
+        with pytest.raises(RuntimeError):
+            broker.query("SELECT COUNT(*) FROM t")
+
+
+class TestCircuitBreaker:
+    def test_quarantine_then_half_open_probe(self):
+        clk = [0.0]
+        coord, merged = _cluster(n_servers=2, replication=2, n_segments=4)
+        plan = FaultPlan(seed=2).fail_server("server0", on_call=1, times=3).attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        broker.health.clock = lambda: clk[0]
+        broker.health.cooldown_s = 30.0
+        conn = sqlite_from_data("t", merged)
+        # 3 consecutive failures trip the breaker (queries stay correct)
+        for _ in range(3):
+            assert_same_rows(broker.query(QUERIES[0]).rows, conn.execute(QUERIES[0]).fetchall())
+        assert broker.health.state("server0") == "open"
+        calls_when_opened = plan.calls("server0")
+        # quarantined: receives NO routes while healthy replicas exist
+        for _ in range(3):
+            broker.query(QUERIES[0])
+        assign, _ = broker._route("t", [f"seg{i}" for i in range(4)], partial_ok=True)
+        assert "server0" not in assign
+        assert plan.calls("server0") == calls_when_opened
+        # cooldown elapses -> half-open -> one probe goes through and (fault
+        # exhausted after 3 calls) succeeds -> breaker closes
+        clk[0] += 31.0
+        assert broker.health.state("server0") == "half_open"
+        for _ in range(4):
+            broker.query(QUERIES[0])
+        assert plan.calls("server0") > calls_when_opened
+        assert broker.health.state("server0") == "closed"
+        assert broker.health.consecutive_failures("server0") == 0
+
+    def test_failed_probe_reopens(self):
+        clk = [0.0]
+        h = ServerHealth(failure_threshold=2, cooldown_s=10.0)
+        h.clock = lambda: clk[0]
+        h.record_failure("s"); h.record_failure("s")
+        assert h.state("s") == "open" and not h.available("s")
+        clk[0] = 11.0
+        assert h.state("s") == "half_open" and h.available("s")
+        h.begin_probe("s")
+        assert not h.available("s")  # single-flight probe
+        h.record_failure("s")  # probe failed: re-quarantine, fresh cooldown
+        assert h.state("s") == "open" and not h.available("s")
+        clk[0] = 22.0
+        h.begin_probe("s")
+        h.record_success("s")
+        assert h.state("s") == "closed" and h.available("s")
+
+    def test_coordinator_mark_up_resets_breaker(self):
+        coord, _ = _cluster(n_servers=2, replication=2, n_segments=2)
+        broker = Broker(coord)
+        for _ in range(3):
+            broker.health.record_failure("server0")
+        assert broker.health.state("server0") == "open"
+        coord.mark_down("server0")
+        coord.mark_up("server0")  # recovery (heartbeat re-establishment)
+        assert broker.health.state("server0") == "closed"
+
+
+class TestDeadlinePropagation:
+    def test_server_checks_deadline_between_kernels(self):
+        coord, _ = _cluster(n_servers=1, replication=1, n_segments=3)
+        srv = coord.servers["server0"]
+        from pinot_tpu.sql.parser import parse_query
+
+        ctx = parse_query("SELECT COUNT(*) FROM t")
+        with pytest.raises(QueryTimeoutError, match="out of query budget"):
+            srv.execute(ctx, srv.segment_names("t"), deadline=Deadline(0))
+
+    def test_per_server_timeout_fails_over(self):
+        """A slow replica (injected latency) blows its per-server budget but
+        NOT the query deadline: its segments fail over and rows stay exact."""
+        coord_ok, merged = _cluster(n_servers=2, replication=2)
+        baseline = Broker(coord_ok).query(QUERIES[0]).rows
+        coord, _ = _cluster(n_servers=2, replication=2)
+        plan = FaultPlan(seed=9).attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        sql = "SET serverTimeoutMs = 50; SET timeoutMs = 60000; SELECT COUNT(*), SUM(v) FROM t"
+        # warm up with the IDENTICAL query before arming the fault: compiles
+        # this exact plan + ships segments, so the faulted run below measures
+        # only the injected latency against the per-server cap
+        assert_same_rows(broker.query(sql).rows, baseline)
+        plan.add_latency("server0", ms=150)
+        res = broker.query(sql)
+        assert_same_rows(res.rows, baseline)
+        assert any(e["errorCode"] == "EXECUTION_TIMEOUT_ERROR" for e in res.stats.exceptions)
+        assert res.stats.partial_result is False
+
+    def test_query_deadline_still_raises(self):
+        coord, _ = _cluster(n_servers=2, replication=2, n_segments=2)
+        broker = Broker(coord)
+        with pytest.raises(QueryTimeoutError):
+            broker.query("SET timeoutMs = 0; SELECT COUNT(*) FROM t")
+
+
+class TestRestFaultSurface:
+    def _post(self, port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/query/sql",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    def test_timeout_maps_to_408(self):
+        from pinot_tpu.cluster.rest import QueryServer
+
+        coord, _ = _cluster(n_servers=1, replication=1, n_segments=1)
+        srv = QueryServer(Broker(coord)).start()
+        try:
+            code, payload = self._post(srv.port, {"sql": "SET timeoutMs = 0; SELECT COUNT(*) FROM t"})
+            assert code == 408 and payload["errorCode"] == "EXECUTION_TIMEOUT_ERROR"
+        finally:
+            srv.stop()
+
+    def test_admission_maps_to_503(self):
+        from pinot_tpu.cluster.rest import QueryServer
+        from pinot_tpu.query.engine import QueryEngine
+
+        eng = QueryEngine(memory_budget_bytes=512)  # nothing fits
+        eng.register_table(_schema())
+        eng.add_segment("t", build_segment(_schema(), _data(2000, seed=1), "s0"))
+        srv = QueryServer(eng).start()
+        try:
+            code, payload = self._post(srv.port, {"sql": "SELECT SUM(v) FROM t"})
+            assert code == 503 and payload["errorCode"] == "SERVER_RESOURCE_LIMIT_EXCEEDED"
+        finally:
+            srv.stop()
+
+    def test_partial_result_surfaced_in_broker_response(self):
+        from pinot_tpu.cluster.rest import QueryServer
+
+        coord, _ = _cluster(n_servers=2, replication=1, n_segments=4)
+        FaultPlan(seed=4).always_fail("server0").attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        srv = QueryServer(broker).start()
+        try:
+            code, payload = self._post(
+                srv.port, {"sql": "SET allowPartialResults = true; SELECT COUNT(*) FROM t"}
+            )
+            assert code == 200
+            assert payload["partialResult"] is True
+            assert payload["exceptions"]
+            assert payload["numServersResponded"] < payload["numServersQueried"]
+        finally:
+            srv.stop()
+
+    def test_scatter_error_maps_to_500_with_exceptions(self):
+        from pinot_tpu.cluster.rest import QueryServer
+
+        # maxScatterRetries=0: the first failed round exhausts failover even
+        # though a healthy replica remains -> ScatterGatherError surface
+        coord, _ = _cluster(n_servers=2, replication=2, n_segments=4)
+        FaultPlan(seed=4).always_fail("server0").attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        srv = QueryServer(broker).start()
+        try:
+            code, payload = self._post(
+                srv.port, {"sql": "SET maxScatterRetries = 0; SELECT COUNT(*) FROM t"}
+            )
+            assert code == 500 and payload["errorCode"] == "SERVER_SCATTER_ERROR"
+            assert payload["exceptions"]
+        finally:
+            srv.stop()
+
+    def test_no_replica_maps_to_503(self):
+        from pinot_tpu.cluster.rest import QueryServer
+
+        coord, _ = _cluster(n_servers=2, replication=1, n_segments=4)
+        FaultPlan(seed=4).always_fail("server0").attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        srv = QueryServer(broker).start()
+        try:
+            code, payload = self._post(srv.port, {"sql": "SELECT COUNT(*) FROM t"})
+            assert code == 503 and payload["errorCode"] == "NO_REPLICA_AVAILABLE"
+        finally:
+            srv.stop()
+
+
+class TestFaultPlanHarness:
+    def test_call_counters_and_log(self):
+        coord, _ = _cluster(n_servers=2, replication=2, n_segments=2)
+        plan = FaultPlan(seed=0).add_latency("server0", ms=0.0, on_call=1).attach(coord)
+        broker = Broker(coord)
+        broker.query(QUERIES[0])
+        assert plan.calls("server0") + plan.calls("server1") >= 1
+        assert all(len(entry) == 4 for entry in plan.log)
+
+    def test_fail_rule_raises_server_fault(self):
+        srv = ServerInstance("s0")
+        srv.fault_plan = FaultPlan(seed=0).fail_server("s0", on_call=1)
+        from pinot_tpu.sql.parser import parse_query
+
+        with pytest.raises(ServerFaultError, match="injected fault"):
+            srv.execute(parse_query("SELECT COUNT(*) FROM t"), [])
+
+    def test_flap_rules_drive_coordinator(self):
+        coord, _ = _cluster(n_servers=2, replication=2, n_segments=2)
+        plan = FaultPlan(seed=0)
+        plan.flap_down("server1", on_call=1, of="server0")
+        plan.flap_up("server1", on_call=2, of="server0")
+        plan.attach(coord)
+        plan.on_execute("server0")  # server0's 1st call downs server1
+        assert "server1" not in coord.live
+        plan.on_execute("server0")  # 2nd call brings it back
+        assert "server1" in coord.live

@@ -212,6 +212,105 @@ class TestW004UnlockedSharedRMW:
         assert _rules(src, threaded=False) == []
 
 
+class TestW005WallClockInElapsedMath:
+    def test_flags_time_time_subtraction(self):
+        src = """
+        import time
+
+        def age(started):
+            return time.time() - started
+        """
+        assert _rules(src) == ["W005"]
+
+    def test_flags_aliased_wall_clock_in_comparison(self):
+        src = """
+        import time
+
+        def expired(deadline):
+            now = time.time()
+            return now >= deadline
+        """
+        assert _rules(src) == ["W005"]
+
+    def test_quiet_on_monotonic_and_epoch_stamps(self):
+        src = """
+        import time
+
+        def age(started):
+            return time.monotonic() - started
+
+        def creation_time_ms():
+            return int(time.time() * 1000)
+
+        def stamp():
+            return time.time()
+        """
+        assert _rules(src) == []
+
+    def test_alias_in_other_scope_does_not_leak(self):
+        src = """
+        import time
+
+        def stamp():
+            now = time.time()
+            return now
+
+        def age(now, started):
+            return now - started
+        """
+        assert _rules(src) == []
+
+
+class TestW006SwallowedClusterException:
+    def test_flags_except_continue_without_recording(self):
+        src = """
+        def scatter(servers):
+            out = []
+            for s in servers:
+                try:
+                    out.append(s.execute())
+                except Exception:
+                    continue
+            return out
+        """
+        assert _rules(src, threaded=True) == ["W006"]
+
+    def test_flags_silent_pass(self):
+        src = """
+        def drop(self, name):
+            try:
+                self._close(name)
+            except Exception:
+                pass
+        """
+        assert _rules(src, threaded=True) == ["W006"]
+
+    def test_quiet_when_recorded_or_reraised(self):
+        src = """
+        import logging
+
+        def scatter(self, servers):
+            for s in servers:
+                try:
+                    s.execute()
+                except KeyError:
+                    raise
+                except Exception:
+                    logging.exception("server %s failed", s)
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_w006_requires_cluster_scope(self):
+        src = """
+        def best_effort(x):
+            try:
+                return int(x)
+            except ValueError:
+                pass
+        """
+        assert _rules(src, threaded=False) == []
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

@@ -99,23 +99,42 @@ def main() -> None:
     engine = DistributedEngine()
     engine.register_table("lineorder", stacked)
 
-    ctx = parse_query(
+    sql = (
         "SELECT lo_orderdate, SUM(lo_revenue) FROM lineorder "
         "WHERE lo_quantity < 25 GROUP BY lo_orderdate LIMIT 2500"
     )
+    ctx = parse_query(sql)
 
     r = engine.execute(ctx)  # full-path warm-up: compile + correctness
     assert r.rows, "bench query returned nothing"
     index_uses = list(r.stats.filter_index_uses)
     assert index_uses, "bench filter must ride the range index"
 
-    # ---- end-to-end timing --------------------------------------------
+    # ---- end-to-end timing + latency distribution ---------------------
+    # execute() feeds the dist.queryLatency histogram; resetting first makes
+    # the p50/p95/p99 below cover exactly these runs
+    from pinot_tpu.utils.metrics import METRICS
+
+    METRICS.reset()
     e2e_ts = []
-    for _ in range(3):
+    for _ in range(7):
         t0 = time.perf_counter()
         engine.execute(ctx)
         e2e_ts.append(time.perf_counter() - t0)
     e2e = float(np.min(e2e_ts))
+    lat = METRICS.snapshot()["histograms"]["dist.queryLatency"]
+
+    # ---- per-stage trace summary --------------------------------------
+    # one traced run (separate plan-cache entry: options ride the
+    # fingerprint); per-stage ms aggregated by span base name
+    from pinot_tpu.query.analyze import _span_ms_index
+
+    traced = engine.execute(parse_query("SET trace = true; " + sql))
+    stage_ms = {
+        k: round(v, 3)
+        for k, v in sorted(_span_ms_index(traced.stats.trace).items())
+        if ":" not in k  # per-batch dispatch:N spans already sum under 'dispatch'
+    }
 
     # ---- marginal kernel timing ---------------------------------------
     # Macro-batch launches (round 5): the engine splits the doc axis so one
@@ -231,6 +250,15 @@ def main() -> None:
                 "remeasure_rounds": remeasured,
                 "value_e2e": round(n / e2e, 1),
                 "e2e_seconds": round(e2e, 4),
+                "latency_ms": {
+                    "count": lat["count"],
+                    "p50": round(lat["p50Ms"], 3),
+                    "p95": round(lat["p95Ms"], 3),
+                    "p99": round(lat["p99Ms"], 3),
+                    "mean": round(lat["meanMs"], 3),
+                    "max": round(lat["maxMs"], 3),
+                },
+                "trace_stage_ms": stage_ms,
                 "rows": n,
                 "filter_index_uses": index_uses,
                 "cpu_proxy_rows_per_sec": round(_cpu_proxy(), 1),

@@ -1,6 +1,6 @@
 """JAX-aware repo lint: ast pass over the pinot_tpu tree.
 
-Six rules, each targeting an anti-pattern this codebase has actually
+Seven rules, each targeting an anti-pattern this codebase has actually
 been bitten by (ADVICE r5) or that silently degrades TPU throughput:
 
   W001 float-literal-in-jit   bare float literal used in arithmetic or a
@@ -30,6 +30,15 @@ been bitten by (ADVICE r5) or that silently degrades TPU throughput:
                               neither re-raises nor makes ANY call (no
                               metrics/log/record) — faults on the serving
                               path must be observable, never dropped.
+  W007 unbounded-metric-name  a metric/span name (first argument of a
+                              .counter/.gauge/.timer/.histogram/.span call)
+                              built from an f-string interpolating an
+                              unbounded value (sql text, query/request ids,
+                              uuids, fingerprints): every distinct value
+                              mints a new time series — a cardinality
+                              explosion in the registry and any scraper.
+                              Bounded label spaces (table, segment, server
+                              names) interpolate freely.
 
 Kernel bodies (W001/W002 scope) are functions the module jits: decorated
 with @jax.jit / @partial(jax.jit, ...) or passed by name to jax.jit(...)
@@ -58,6 +67,7 @@ RULES: Dict[str, str] = {
     "W004": "unlocked read-modify-write of shared state in cluster class",
     "W005": "wall-clock time.time() in elapsed-time math (use monotonic/perf_counter)",
     "W006": "except block in cluster/ swallows the exception without recording it",
+    "W007": "metric/span name interpolates an unbounded value (cardinality explosion)",
 }
 
 _HOST_SYNC_ATTRS = frozenset({"item", "block_until_ready", "device_get", "tolist"})
@@ -449,6 +459,52 @@ def _check_w006(path: str, tree: ast.AST, findings: List[Finding]) -> None:
             )
 
 
+_METRIC_NAME_SINKS = frozenset({"counter", "gauge", "timer", "histogram", "span"})
+_UNBOUNDED_HINTS = ("sql", "query", "qid", "uuid", "fingerprint", "text")
+
+
+def _unbounded_hint(name: str) -> bool:
+    """Identifier that smells like a per-request value: sql text, query /
+    request ids, uuids, fingerprints.  Table/segment/server names are
+    bounded label spaces and interpolate freely."""
+    low = name.lower()
+    return low == "id" or low.endswith("_id") or any(h in low for h in _UNBOUNDED_HINTS)
+
+
+def _check_w007(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """Metric/span names from f-strings interpolating unbounded values:
+    `METRICS.counter(f"lat.{sql}")` mints one counter PER DISTINCT QUERY —
+    the registry (and any Prometheus scraper behind it) grows without
+    bound.  Scope is the name argument of the registry factories and
+    trace spans; only the interpolated expressions are inspected, so
+    `f"server.segmentBytes.{table}"` stays clean."""
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_NAME_SINKS
+            and node.args
+            and isinstance(node.args[0], ast.JoinedStr)
+        ):
+            continue
+        for part in node.args[0].values:
+            if not isinstance(part, ast.FormattedValue):
+                continue
+            for n in ast.walk(part.value):
+                name = n.id if isinstance(n, ast.Name) else (
+                    n.attr if isinstance(n, ast.Attribute) else None
+                )
+                if name is not None and _unbounded_hint(name):
+                    findings.append(
+                        Finding(
+                            path, node.lineno, "W007",
+                            f"metric/span name interpolates unbounded value {name!r} "
+                            f"in .{node.func.attr}(...) — one series per distinct value",
+                        )
+                    )
+                    break
+
+
 def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> List[Finding]:
     """Lint one module's source.  `threaded` enables the cluster/-scoped
     rules (W004 shared-state races, W006 swallowed exceptions)."""
@@ -472,6 +528,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
     _check_w003(path, tree, findings)
     _check_sync_in_loop(path, tree, findings)
     _check_w005(path, tree, findings)
+    _check_w007(path, tree, findings)
     if threaded:
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)

@@ -15,6 +15,7 @@ selection — matches the reference contracts.
 """
 from __future__ import annotations
 
+import itertools
 import random
 import threading
 import time
@@ -26,7 +27,8 @@ from pinot_tpu.query.ir import FilterNode, FilterOp, PredicateType, QueryContext
 from pinot_tpu.query.result import ExecutionStats, ResultTable
 from pinot_tpu.query.safety import Deadline, QueryTimeoutError
 from pinot_tpu.utils.hashing import partition_of
-from pinot_tpu.utils.metrics import METRICS
+from pinot_tpu.utils.metrics import METRICS, Trace
+from pinot_tpu.utils.slowlog import SlowQueryLog
 
 
 class QuotaExceededError(RuntimeError):
@@ -160,6 +162,7 @@ class ServerHealth:
                 self._opened_at[server] = self.clock()
                 if not was_open:
                     METRICS.counter("broker.serversQuarantined").inc()
+            self._publish_gauges_locked(server)
 
     def record_success(self, server: str) -> None:
         with self._lock:
@@ -167,6 +170,15 @@ class ServerHealth:
             if self._opened_at.pop(server, None) is not None:
                 METRICS.counter("broker.serversRecovered").inc()
             self._probing.discard(server)
+            self._publish_gauges_locked(server)
+
+    def _publish_gauges_locked(self, server: str) -> None:
+        """Breaker-state gauges (caller holds self._lock): total open
+        breakers plus a per-server 0/1 flag for alerting on one replica."""
+        METRICS.gauge("broker.openBreakers").set(len(self._opened_at))
+        METRICS.gauge(f"broker.breakerOpen.{server}").set(
+            1.0 if server in self._opened_at else 0.0
+        )
 
     def state(self, server: str) -> str:
         with self._lock:
@@ -202,6 +214,7 @@ class ServerHealth:
             self._consecutive.pop(server, None)
             self._opened_at.pop(server, None)
             self._probing.discard(server)
+            self._publish_gauges_locked(server)
 
 
 class Broker:
@@ -217,6 +230,12 @@ class Broker:
         # are deterministic and never wall-clock sensitive
         self.retry_rng = random.Random(0x5CA77E12)
         self._sleep = time.sleep
+        # query-id mint: itertools.count is atomic under the GIL, so handler
+        # threads never need a lock for the sequence (W004-clean by design)
+        self._qid_seq = itertools.count(1)
+        self._broker_id = f"{random.getrandbits(32):08x}"
+        # recent-query ring buffer behind GET /debug/queries + cli slow-queries
+        self.slow_queries = SlowQueryLog()
         coordinator.on_live_change(self._on_live_change)
 
     def _on_live_change(self, name: str, up: bool) -> None:
@@ -326,7 +345,17 @@ class Broker:
     def query(self, sql: str) -> ResultTable:
         from pinot_tpu.sql.parser import parse_query
 
-        return self.execute(parse_query(sql))
+        ctx = parse_query(sql)
+        if ctx.options.get("__explain__"):
+            return self.execute(ctx)  # plan-only: not a served query
+        fp = ctx.fingerprint()
+        try:
+            out = self.execute(ctx)
+        except Exception as e:
+            self.slow_queries.record(sql, fp, None, error=f"{type(e).__name__}: {e}")
+            raise
+        self.slow_queries.record(sql, fp, out)
+        return out
 
     def execute(self, ctx: QueryContext, _charged: frozenset = frozenset()) -> ResultTable:
         from pinot_tpu.query.engine import apply_set_ops, resolve_subqueries
@@ -335,6 +364,8 @@ class Broker:
         apply_env_defaults(ctx.options)
         if ctx.options.get("__explain__"):
             return self._explain(ctx)
+        if ctx.options.get("__analyze__"):
+            return self._explain_analyze(ctx)
         # quota charges ONCE per client request PER TABLE — set-op operands
         # and subqueries recurse with their outer tables pre-paid, but a
         # different table inside the request still pays its own quota
@@ -355,13 +386,19 @@ class Broker:
         table = ctx.table
         if table not in self.coordinator.tables:
             raise KeyError(f"table {table!r} not found")
+        # root span: the broker mints the query id; every server subtree
+        # grafts under this one tree (RequestContext analog)
+        qid = f"{self._broker_id}_{next(self._qid_seq)}"
+        trace = Trace(bool(ctx.options.get("trace", False)), query_id=qid)
+        METRICS.counter("broker.queries").inc()
         # schema-aware static validation before scatter: a malformed plan
         # fails ONCE at the broker with a structured error instead of
         # failing per-server inside jit tracing
         from pinot_tpu.analysis.plan_check import check_plan
 
-        check_plan(ctx, self.coordinator.tables[table].schema)
-        self._inject_global_ranges(ctx, table)
+        with trace.span("plan"):
+            check_plan(ctx, self.coordinator.tables[table].schema)
+            self._inject_global_ranges(ctx, table)
         # hybrid tables (offline segments + a realtime manager under ONE
         # name): a TIME BOUNDARY splits the parts — offline answers
         # ts <= boundary, realtime answers ts > boundary (TimeBoundaryManager
@@ -381,31 +418,52 @@ class Broker:
                 boundary = max(ends)
                 offline_ctx = _with_time_bound(ctx, tc, upper=boundary)
                 realtime_ctx = _with_time_bound(ctx, tc, lower_exclusive=boundary)
-        seg_names, pruned = self._prune(offline_ctx, table)
+        with trace.span("prune", table=table) as psp:
+            seg_names, pruned = self._prune(offline_ctx, table)
+        if psp is not None:
+            psp.annotate(segments=len(seg_names), pruned=pruned)
         stats = ExecutionStats(num_segments_pruned=pruned)
         results = []
         if seg_names:
-            results.extend(self._scatter(offline_ctx, table, seg_names, meta, deadline, stats))
+            METRICS.gauge("broker.inFlightScatters").add(1)
+            try:
+                with trace.span("scatter", segments=len(seg_names)):
+                    results.extend(
+                        self._scatter(offline_ctx, table, seg_names, meta, deadline, stats, trace)
+                    )
+            finally:
+                METRICS.gauge("broker.inFlightScatters").add(-1)
         # realtime tables: sealed + consuming segments served from the
         # coordinator-owned manager (the RealtimeTableDataManager view)
         rt = self.coordinator.realtime.get(table)
         if rt is not None:
             from pinot_tpu.query import executor as sse_executor
 
-            for seg in rt.query_segments():
-                deadline.check(f"query on {table}")
-                stats.num_segments_queried += 1
-                stats.total_docs += seg.num_docs
-                if sse_executor.prune_segment(realtime_ctx, seg):
-                    stats.num_segments_pruned += 1
-                    continue
-                res, sstats = sse_executor.execute_segment(realtime_ctx, seg)
-                stats.num_segments_processed += 1
-                stats.num_docs_scanned += sstats.num_docs_scanned
-                stats.add_index_uses(sstats.filter_index_uses)
-                results.append(res)
-        out = reduce_mod.reduce_results(ctx, results, stats)
+            with trace.span("realtime") as rsp:
+                rt_docs = 0
+                for seg in rt.query_segments():
+                    deadline.check(f"query on {table}")
+                    stats.num_segments_queried += 1
+                    stats.total_docs += seg.num_docs
+                    if sse_executor.prune_segment(realtime_ctx, seg):
+                        stats.num_segments_pruned += 1
+                        continue
+                    res, sstats = sse_executor.execute_segment(realtime_ctx, seg)
+                    stats.num_segments_processed += 1
+                    stats.num_docs_scanned += sstats.num_docs_scanned
+                    rt_docs += sstats.num_docs_scanned
+                    stats.add_index_uses(sstats.filter_index_uses)
+                    results.append(res)
+                if rsp is not None:
+                    rsp.annotate(docs=rt_docs)
+        with trace.span("reduce"):
+            out = reduce_mod.reduce_results(ctx, results, stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
+        out.stats.query_id = qid
+        tr = trace.finish()
+        if tr is not None:
+            out.stats.trace = tr
+        METRICS.histogram("broker.queryLatency").update(out.stats.time_ms)
         return out
 
     # -- fault-tolerant scatter-gather ------------------------------------
@@ -417,6 +475,7 @@ class Broker:
         meta,
         deadline: Deadline,
         stats: ExecutionStats,
+        trace: Optional[Trace] = None,
     ) -> List:
         """Deadline-budgeted scatter with replica failover (the
         QueryRouter.submitQuery + BaseSingleStageBrokerRequestHandler retry
@@ -429,7 +488,14 @@ class Broker:
         When a segment has no replica left: with allowPartialResults=true
         the response degrades (partialResult=true + exception entries +
         numServersResponded < numServersQueried); otherwise the query fails
-        with the collected per-server exceptions."""
+        with the collected per-server exceptions.
+
+        Tracing: each failover round gets a `round:N` span; each routed call
+        a `server_execute` span (server, round, probe, error, breaker state)
+        with the server's own finished subtree grafted beneath it — the
+        retry/breaker machinery is visible in ONE tree per query."""
+        if trace is None:
+            trace = Trace(False)
         opts = ctx.options
         allow_partial = str(opts.get("allowPartialResults", "")).lower() in ("1", "true", "yes")
         max_retries = int(opts.get("maxScatterRetries", 2))
@@ -443,55 +509,69 @@ class Broker:
         rounds = 0
         try:
             while pending:
-                assign, unroutable = self._route(
-                    table, pending, exclude=frozenset(excluded), partial_ok=True
-                )
-                if unroutable:
-                    self._absorb_unroutable(table, unroutable, excluded, allow_partial, stats)
-                failed: List[str] = []
-                for server_name, segs in assign.items():
-                    deadline.check(f"query on {table}")
-                    server = self.coordinator.servers[server_name]
-                    queried.add(server_name)
-                    self.health.begin_probe(server_name)  # no-op unless half-open
-                    per_call = deadline.bounded(
-                        float(server_timeout_ms) if server_timeout_ms is not None else None
+                with trace.span(f"round:{rounds}", segments=len(pending)):
+                    assign, unroutable = self._route(
+                        table, pending, exclude=frozenset(excluded), partial_ok=True
                     )
-                    self.server_stats.begin(server_name)
-                    st0 = time.perf_counter()
-                    try:
-                        res, sstats = server.execute(
-                            ctx, segs, table_schema=meta.schema, deadline=per_call
+                    if unroutable:
+                        self._absorb_unroutable(table, unroutable, excluded, allow_partial, stats)
+                    failed: List[str] = []
+                    for server_name, segs in assign.items():
+                        deadline.check(f"query on {table}")
+                        server = self.coordinator.servers[server_name]
+                        queried.add(server_name)
+                        probe = self.health.state(server_name) == "half_open"
+                        self.health.begin_probe(server_name)  # no-op unless half-open
+                        per_call = deadline.bounded(
+                            float(server_timeout_ms) if server_timeout_ms is not None else None
                         )
-                    except Exception as e:  # noqa: BLE001 — every fault is recorded below
-                        self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
-                        if isinstance(e, QueryTimeoutError) and deadline.expired():
-                            raise  # the QUERY is out of budget, not just this server
-                        self.server_stats.punish(server_name)
-                        self.health.record_failure(server_name)
-                        excluded.add(server_name)
-                        failed.extend(segs)
-                        stats.exceptions.append(
-                            {
-                                "errorCode": "EXECUTION_TIMEOUT_ERROR"
-                                if isinstance(e, QueryTimeoutError)
-                                else "SERVER_SCATTER_ERROR",
-                                "message": f"server {server_name}: {type(e).__name__}: {e}",
-                                "server": server_name,
-                            }
-                        )
-                        METRICS.counter("broker.scatterServerFailures").inc()
-                        continue
-                    self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
-                    self.health.record_success(server_name)
-                    responded.add(server_name)
-                    results.extend(res)
-                    stats.num_segments_queried += sstats.num_segments_queried
-                    stats.num_segments_processed += sstats.num_segments_processed
-                    stats.num_segments_pruned += sstats.num_segments_pruned
-                    stats.num_docs_scanned += sstats.num_docs_scanned
-                    stats.total_docs += sstats.total_docs
-                    stats.add_index_uses(sstats.filter_index_uses)
+                        self.server_stats.begin(server_name)
+                        st0 = time.perf_counter()
+                        with trace.span(
+                            "server_execute", server=server_name, segments=len(segs),
+                            round=rounds, probe=probe,
+                        ) as ssp:
+                            try:
+                                res, sstats = server.execute(
+                                    ctx, segs, table_schema=meta.schema, deadline=per_call
+                                )
+                            except Exception as e:  # noqa: BLE001 — every fault is recorded below
+                                self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
+                                if isinstance(e, QueryTimeoutError) and deadline.expired():
+                                    raise  # the QUERY is out of budget, not just this server
+                                self.server_stats.punish(server_name)
+                                self.health.record_failure(server_name)
+                                excluded.add(server_name)
+                                failed.extend(segs)
+                                stats.exceptions.append(
+                                    {
+                                        "errorCode": "EXECUTION_TIMEOUT_ERROR"
+                                        if isinstance(e, QueryTimeoutError)
+                                        else "SERVER_SCATTER_ERROR",
+                                        "message": f"server {server_name}: {type(e).__name__}: {e}",
+                                        "server": server_name,
+                                    }
+                                )
+                                METRICS.counter("broker.scatterServerFailures").inc()
+                                if ssp is not None:
+                                    ssp.annotate(
+                                        error=f"{type(e).__name__}: {e}",
+                                        breaker=self.health.state(server_name),
+                                    )
+                                continue
+                            self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
+                            self.health.record_success(server_name)
+                            responded.add(server_name)
+                            results.extend(res)
+                            stats.num_segments_queried += sstats.num_segments_queried
+                            stats.num_segments_processed += sstats.num_segments_processed
+                            stats.num_segments_pruned += sstats.num_segments_pruned
+                            stats.num_docs_scanned += sstats.num_docs_scanned
+                            stats.total_docs += sstats.total_docs
+                            stats.add_index_uses(sstats.filter_index_uses)
+                            trace.graft(sstats.trace)
+                            if ssp is not None:
+                                ssp.annotate(docs=sstats.num_docs_scanned)
                 pending = failed
                 if pending:
                     rounds += 1
@@ -561,6 +641,19 @@ class Broker:
         shim = QueryEngine()
         shim.register_table(meta.schema, meta.config)
         return shim._explain(ctx, segs)
+
+    def _explain_analyze(self, ctx: QueryContext) -> ResultTable:
+        """EXPLAIN ANALYZE: run the query with tracing forced, then join the
+        static operator tree with the measured span tree (query.analyze)."""
+        from pinot_tpu.query.analyze import analyze_result
+
+        ctx.options.pop("__analyze__", None)
+        ctx.options["trace"] = True
+        for _op, _all, rhs in ctx.set_ops:
+            rhs.options.pop("__analyze__", None)
+            rhs.options["trace"] = True
+        executed = self.execute(ctx)
+        return analyze_result(self._explain(ctx), executed)
 
     def _inject_global_ranges(self, ctx: QueryContext, table: str) -> None:
         """Table-global sketch constants from broker-side metadata (the

@@ -2,7 +2,8 @@
 
 Reference parity: Pinot's broker query REST (POST /query/sql handled by
 BaseSingleStageBrokerRequestHandler) + cursor endpoints + /health and
-/metrics.  Re-design: stdlib http.server on a daemon thread serving an
+/metrics (JSON, or Prometheus text with ?format=prometheus) and the
+/debug/queries slow-query surface.  Re-design: stdlib http.server on a daemon thread serving an
 in-process QueryEngine or cluster Broker — the data plane stays in-process
 (SURVEY.md §2.6); this surface exists for clients/tools parity.
 
@@ -13,6 +14,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -49,6 +51,7 @@ def broker_response(result: ResultTable) -> Dict[str, Any]:
         "numSegmentsProcessed": s.num_segments_processed,
         "totalDocs": s.total_docs,
         "timeUsedMs": round(s.time_ms, 3),
+        "requestId": s.query_id,
         "trace": s.trace,
         # fault surface (BrokerResponse partialResult / processingExceptions)
         "partialResult": bool(s.partial_result),
@@ -78,14 +81,38 @@ class QueryServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_text(self, code: int, text: str, content_type: str) -> None:
+                body = text.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 try:
-                    if self.path == "/health":
+                    url = urllib.parse.urlsplit(self.path)
+                    qs = urllib.parse.parse_qs(url.query)
+                    if url.path == "/health":
                         self._send(200, {"status": "OK"})
-                    elif self.path == "/metrics":
-                        self._send(200, METRICS.snapshot())
-                    elif self.path.startswith("/cursors/"):
-                        parts = self.path.strip("/").split("/")
+                    elif url.path == "/metrics":
+                        if qs.get("format", [""])[0] == "prometheus":
+                            self._send_text(
+                                200,
+                                METRICS.to_prometheus(),
+                                "text/plain; version=0.0.4; charset=utf-8",
+                            )
+                        else:
+                            self._send(200, METRICS.snapshot())
+                    elif url.path == "/debug/queries":
+                        slow = getattr(outer.engine, "slow_queries", None)
+                        if slow is None:
+                            self._send(404, {"error": "engine has no slow-query log"})
+                            return
+                        limit = int(qs.get("limit", ["0"])[0]) or None
+                        self._send(200, {"queries": slow.snapshot(limit)})
+                    elif url.path.startswith("/cursors/"):
+                        parts = url.path.strip("/").split("/")
                         cid = parts[1]
                         page = int(parts[2]) if len(parts) > 2 else 0
                         self._send(200, outer.cursors.fetch(cid, page))

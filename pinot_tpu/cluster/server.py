@@ -30,6 +30,17 @@ from pinot_tpu.segment.segment import ImmutableSegment
 from pinot_tpu.utils.metrics import METRICS
 
 
+def _segment_bytes(segment: ImmutableSegment) -> int:
+    """Host-array bytes of one segment (codes/values/null masks/MV lengths)
+    — the per-table residency the segmentBytes gauge tracks."""
+    total = 0
+    for c in segment.columns.values():
+        for arr in (c.codes, c.values, c.nulls, c.mv_lengths):
+            if arr is not None:
+                total += arr.nbytes
+    return total
+
+
 class ServerInstance:
     def __init__(self, name: str, device=None, fault_plan=None):
         self.name = name
@@ -42,9 +53,14 @@ class ServerInstance:
     # -- data manager ----------------------------------------------------
     def add_segment(self, table: str, segment: ImmutableSegment) -> None:
         self.segments.setdefault(table, {})[segment.name] = segment
+        # device-residency gauge: segment host arrays mirror what the
+        # executor's pytree cache pins in HBM for this table
+        METRICS.gauge(f"server.segmentBytes.{table}").add(_segment_bytes(segment))
 
     def drop_segment(self, table: str, seg_name: str) -> None:
-        self.segments.get(table, {}).pop(seg_name, None)
+        seg = self.segments.get(table, {}).pop(seg_name, None)
+        if seg is not None:
+            METRICS.gauge(f"server.segmentBytes.{table}").add(-_segment_bytes(seg))
 
     def get_segment(self, table: str, seg_name: str) -> Optional[ImmutableSegment]:
         return self.segments.get(table, {}).get(seg_name)
@@ -61,38 +77,76 @@ class ServerInstance:
         deadline: Optional[Deadline] = None,
     ):
         """Run one query over the named LOCAL segments; returns
-        (segment results, stats) — the DataTable the reference ships back."""
-        from pinot_tpu.query.planner import _needed_columns
+        (segment results, stats) — the DataTable the reference ships back.
 
+        Tracing (ctx option `trace`): builds a per-server span subtree —
+        dispatch (host-side plan+ship+async-launch per segment), device_wait
+        (ONE block_until_ready over every pending output: the device-compute
+        share the async dispatch hides), then per-segment collect spans —
+        annotated with segments/docs/backend and any fault-plan events, and
+        ships it back via stats.trace for the broker to graft."""
+        from pinot_tpu.query.planner import _needed_columns
+        from pinot_tpu.utils.metrics import Trace
+
+        trace = Trace(bool(ctx.options.get("trace", False)), root=f"server:{self.name}")
         plan = self.fault_plan
         if plan is not None:
+            fault_n0 = len(plan.log)
             plan.on_execute(self.name)  # may sleep, flap liveness, or raise
+            if trace.enabled and len(plan.log) > fault_n0:
+                trace.annotate(faults=[k for (_, _, k, _) in plan.log[fault_n0:]])
         stats = ExecutionStats()
         results = []
         pending = []
-        for name in seg_names:
-            self._check_budget(deadline, cancelled=len(pending))
-            seg = self.get_segment(ctx.table, name)
-            if seg is not None and plan is not None and plan.segment_dropped(self.name, ctx.table, name):
-                seg = None
-            if seg is None:
-                raise KeyError(f"server {self.name} does not serve {ctx.table}/{name}")
-            stats.num_segments_queried += 1
-            stats.total_docs += seg.num_docs
-            if table_schema is not None:
-                seg.ensure_columns(table_schema, _needed_columns(ctx, seg))
-            if executor.prune_segment(ctx, seg):
-                stats.num_segments_pruned += 1
-                continue
-            # pipelined: dispatch all kernels async, then drain (executor.py)
-            pending.append(executor.launch_segment(ctx, seg, device=self.device))
+        with trace.span("dispatch") as dsp:
+            for name in seg_names:
+                self._check_budget(deadline, cancelled=len(pending))
+                seg = self.get_segment(ctx.table, name)
+                if seg is not None and plan is not None and plan.segment_dropped(self.name, ctx.table, name):
+                    seg = None
+                if seg is None:
+                    raise KeyError(f"server {self.name} does not serve {ctx.table}/{name}")
+                stats.num_segments_queried += 1
+                stats.total_docs += seg.num_docs
+                if table_schema is not None:
+                    seg.ensure_columns(table_schema, _needed_columns(ctx, seg))
+                if executor.prune_segment(ctx, seg):
+                    stats.num_segments_pruned += 1
+                    continue
+                # pipelined: dispatch all kernels async, then drain (executor.py)
+                with trace.span(f"launch:{seg.name}"):
+                    pending.append(executor.launch_segment(ctx, seg, device=self.device))
+            if dsp is not None:
+                dsp.annotate(launches=len(pending))
+        if trace.enabled:
+            # device/host time split: ONE fence over every pending output
+            # (trace-only — the untraced path lets collect's device_get be
+            # the fence so cancellation stays responsive between collects)
+            import jax
+
+            with trace.span("device_wait", launches=len(pending)):
+                jax.block_until_ready(executor.pending_outputs(pending))
         for i, st in enumerate(pending):
             self._check_budget(deadline, cancelled=len(pending) - i)
-            res, seg_stats = executor.collect_segment(st)
+            with trace.span("collect") as csp:
+                res, seg_stats = executor.collect_segment(st)
+            if csp is not None:
+                csp.annotate(docs=seg_stats.num_docs_scanned)
             stats.num_segments_processed += 1
             stats.num_docs_scanned += seg_stats.num_docs_scanned
             stats.add_index_uses(seg_stats.filter_index_uses)
             results.append(res)
+        if trace.enabled:
+            from pinot_tpu import ops
+
+            trace.annotate(
+                server=self.name,
+                segments=len(seg_names),
+                segmentsPruned=stats.num_segments_pruned,
+                docsScanned=stats.num_docs_scanned,
+                backend=ops.scan_backend(),
+            )
+            stats.trace = trace.finish()
         return results, stats
 
     def _check_budget(self, deadline: Optional[Deadline], cancelled: int) -> None:

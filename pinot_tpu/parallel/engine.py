@@ -44,6 +44,7 @@ from pinot_tpu.query.result import (
     SelectionSegmentResult,
 )
 from pinot_tpu.query.transform import as_row_array, eval_expr
+from pinot_tpu.utils.metrics import METRICS
 
 
 def shard_map_compat(f, *, mesh, in_specs, out_specs):
@@ -234,6 +235,14 @@ class DistributedEngine:
                 f"num_shards={stacked.num_shards} not divisible by mesh size {self.num_devices}"
             )
         self.tables[name] = stacked
+        # HBM residency gauge: stacked host arrays mirror what to_device
+        # pins across the mesh for this table
+        nbytes = 0
+        for c in stacked.columns.values():
+            for arr in (c.codes, c.values, c.nulls, c.mv_lengths):
+                if arr is not None:
+                    nbytes += arr.nbytes
+        METRICS.gauge(f"hbm.pinnedBytes.{name}").set(float(nbytes))
         # drop stale self-join facades of a re-registered table (mse/plan.py
         # resolve registers them as '{name}@{alias}')
         for k in [k for k in self.tables if k.startswith(name + "@")]:
@@ -282,6 +291,8 @@ class DistributedEngine:
         if t is not None:
             out.stats.trace = t
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
+        METRICS.counter("dist.queries").inc()
+        METRICS.histogram("dist.queryLatency").update(out.stats.time_ms)
         return out
 
     @staticmethod
@@ -765,13 +776,22 @@ class DistributedEngine:
         keep_device = plan.kind == "groupby_sparse" and plan.sparse_merge_fn is not None
         batch_outs = []
         pending: List[Any] = []
-        with trace.span("launches"):
-            for cols, params in self.device_batches(plan, stacked):
-                pending.append(plan.fn(cols, params))
+        with trace.span("launches") as lsp:
+            for i, (cols, params) in enumerate(self.device_batches(plan, stacked)):
+                with trace.span(f"dispatch:{i}"):
+                    pending.append(plan.fn(cols, params))
                 if len(pending) >= depth:
-                    batch_outs.append(self._drain(pending.pop(0), keep_device))
+                    with trace.span("drain"):
+                        batch_outs.append(self._drain(pending.pop(0), keep_device))
             while pending:
-                batch_outs.append(self._drain(pending.pop(0), keep_device))
+                with trace.span("drain"):
+                    batch_outs.append(self._drain(pending.pop(0), keep_device))
+            if lsp is not None:
+                lsp.annotate(
+                    batches=len(plan.batch_offsets),
+                    pipelineDepth=depth,
+                    backend=ops.scan_backend(),
+                )
 
         if plan.kind == "aggregation":
             partials = self._combine_partials(batch_outs)

@@ -8,6 +8,7 @@ reduce.
 """
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -41,10 +42,13 @@ class TableState:
 class QueryEngine:
     def __init__(self, memory_budget_bytes: int = 8 << 30, secondary_slots: int = 2) -> None:
         from pinot_tpu.query.safety import MemoryAccountant, WorkloadScheduler
+        from pinot_tpu.utils.slowlog import SlowQueryLog
 
         self.tables: Dict[str, TableState] = {}
         self.accountant = MemoryAccountant(memory_budget_bytes)
         self.scheduler = WorkloadScheduler(secondary_slots)
+        self._qid_seq = itertools.count(1)
+        self.slow_queries = SlowQueryLog()
 
     # -- table registry (controller-lite) -------------------------------
     def register_table(self, schema: Schema, config: Optional[TableConfig] = None) -> None:
@@ -68,6 +72,8 @@ class QueryEngine:
             # explain never executes anything — not subqueries, not set-op
             # components (review-caught: per-component explains would union)
             return self._explain(ctx, self.table(ctx.table).query_segments())
+        if ctx.options.get("__analyze__"):
+            return self._explain_analyze(ctx, device=device)
         resolve_subqueries(ctx, lambda c: self.execute(c, device=device))
         if ctx.set_ops:
             return apply_set_ops(ctx, lambda c: self.execute(c, device=device))
@@ -82,7 +88,8 @@ class QueryEngine:
 
         t0 = time.perf_counter()
         deadline = Deadline.from_ctx(ctx)
-        trace = Trace(bool(ctx.options.get("trace", False)))
+        req_id = f"engine_{next(self._qid_seq)}"
+        trace = Trace(bool(ctx.options.get("trace", False)), query_id=req_id)
         METRICS.counter("queries").inc()
         state = self.table(ctx.table)
         # schema-aware static validation before any per-segment planning:
@@ -133,6 +140,14 @@ class QueryEngine:
                     continue
                 with trace.span(f"launch:{seg.name}"):
                     pending.append(executor.launch_segment(ctx, seg, device=device))
+            if trace.enabled:
+                # device/host time split: ONE fence over every pending output
+                # (trace-only — the untraced path lets collect's device_get
+                # fence so deadline checks stay responsive between collects)
+                import jax
+
+                with trace.span("device_wait", launches=len(pending)):
+                    jax.block_until_ready(executor.pending_outputs(pending))
             for st in pending:
                 deadline.check(f"query on {ctx.table}")
                 with trace.span("collect"):
@@ -151,10 +166,26 @@ class QueryEngine:
             self.accountant.release(qid)
             release_slot()
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
+        out.stats.query_id = req_id
         out.stats.trace = trace.finish()
-        METRICS.timer("queryLatency").update(out.stats.time_ms)
+        METRICS.histogram("queryLatency").update(out.stats.time_ms)
         METRICS.counter("docsScanned").inc(stats.num_docs_scanned)
         return out
+
+    def _explain_analyze(self, ctx: QueryContext, device=None) -> ResultTable:
+        """EXPLAIN ANALYZE: run the query with tracing forced, then join the
+        static operator tree with the measured span tree (query.analyze)."""
+        from pinot_tpu.query.analyze import analyze_result
+
+        ctx.options.pop("__analyze__", None)
+        ctx.options["trace"] = True
+        for _op, _all, rhs in ctx.set_ops:
+            rhs.options.pop("__analyze__", None)
+            rhs.options["trace"] = True
+        executed = self.execute(ctx, device=device)
+        return analyze_result(
+            self._explain(ctx, self.table(ctx.table).query_segments()), executed
+        )
 
     def _explain(self, ctx: QueryContext, segments) -> ResultTable:
         """EXPLAIN PLAN FOR: per-shape operator tree rows (Pinot's explain
@@ -246,11 +277,21 @@ class QueryEngine:
                     ctx.options.setdefault(f"__dictvals__{col}", dict_values)
 
     def query(self, sql: str, device=None) -> ResultTable:
-        """SQL front door (CalciteSqlParser analog lives in sql/)."""
+        """SQL front door (CalciteSqlParser analog lives in sql/); finished
+        requests land in the slow-query ring (utils/slowlog.py)."""
         from pinot_tpu.sql.parser import parse_query
 
         ctx = parse_query(sql)
-        return self.execute(ctx, device=device)
+        if ctx.options.get("__explain__"):
+            return self.execute(ctx, device=device)  # plan-only: not served
+        fp = ctx.fingerprint()
+        try:
+            out = self.execute(ctx, device=device)
+        except Exception as e:
+            self.slow_queries.record(sql, fp, None, error=f"{type(e).__name__}: {e}")
+            raise
+        self.slow_queries.record(sql, fp, out)
+        return out
 
     def sql(self, statement: str, device=None) -> ResultTable:
         """DDL + DML front door (the pinot-sql-ddl controller resource)."""

@@ -122,6 +122,14 @@ def launch_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
     return ("pending", ctx, segment, plan, out, stats)
 
 
+def pending_outputs(states) -> list:
+    """Device output pytrees of the not-yet-collected launch states — the
+    tracing layer fences on ALL of these with ONE jax.block_until_ready to
+    split device compute time from host dispatch (never per-launch: a
+    per-launch fence in the loop would serialize the pipeline, lint W002)."""
+    return [st[4] for st in states if st[0] == "pending"]
+
+
 def collect_segment(state):
     """Phase 2: block on the kernel's outputs and finish host-side."""
     import jax

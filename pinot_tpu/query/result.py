@@ -40,6 +40,8 @@ class ExecutionStats:
     filter_index_uses: Tuple = ()
     # span tree dict when the query ran with trace=true (utils/metrics.Trace)
     trace: Optional[dict] = None
+    # broker/engine-minted request id (RequestContext requestId analog)
+    query_id: Optional[str] = None
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_segments_queried += other.num_segments_queried
@@ -53,6 +55,7 @@ class ExecutionStats:
         self.partial_result = self.partial_result or other.partial_result
         self.exceptions.extend(other.exceptions)
         self.add_index_uses(other.filter_index_uses)
+        self.query_id = self.query_id or other.query_id
 
     def add_index_uses(self, uses: Tuple) -> None:
         """Order-preserving dedup-union into filter_index_uses."""

@@ -240,15 +240,22 @@ class _Parser:
     # -- entry -----------------------------------------------------------
     def parse(self) -> QueryContext:
         options = {}
-        # EXPLAIN PLAN FOR SELECT ... (Pinot explain syntax); matched as
-        # words, not keywords, so `plan`/`for` stay valid identifiers
+        # EXPLAIN PLAN FOR SELECT ... (Pinot explain syntax) or
+        # EXPLAIN ANALYZE SELECT ... (execute with tracing forced, join the
+        # operator tree with measured ms/rows); matched as words, not
+        # keywords, so `plan`/`for`/`analyze` stay valid identifiers
         if self.cur.kind == "ident" and str(self.cur.value).lower() == "explain":
             self.advance()
-            for w in ("plan", "for"):
-                if not (self.cur.kind in ("ident", "kw") and str(self.cur.value).lower() == w):
-                    self.fail(f"expected {w.upper()} after EXPLAIN")
+            if self.cur.kind in ("ident", "kw") and str(self.cur.value).lower() == "analyze":
                 self.advance()
-            options["__explain__"] = True
+                options["__analyze__"] = True
+                options["trace"] = True
+            else:
+                for w in ("plan", "for"):
+                    if not (self.cur.kind in ("ident", "kw") and str(self.cur.value).lower() == w):
+                        self.fail("expected PLAN FOR or ANALYZE after EXPLAIN")
+                    self.advance()
+                options["__explain__"] = True
         # Pinot option prelude: SET key = value; ... SELECT ...
         while self.at_kw("set"):
             self.advance()

@@ -8,6 +8,7 @@ PostQuery, StartServiceManager/quickstart commands).
   python -m pinot_tpu.tools.cli serve --segments dir1 --port 8099
   python -m pinot_tpu.tools.cli quickstart
   python -m pinot_tpu.tools.cli lint [paths...]
+  python -m pinot_tpu.tools.cli slow-queries --url http://127.0.0.1:8099
 """
 from __future__ import annotations
 
@@ -127,6 +128,35 @@ def cmd_quickstart(args) -> int:
     return 0
 
 
+def cmd_slow_queries(args) -> int:
+    """Print a serving broker/engine's recent-query ring (GET /debug/queries):
+    newest first, one line per query, trace presence flagged."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + f"/debug/queries?limit={args.limit}"
+    with urllib.request.urlopen(url) as resp:
+        payload = json.loads(resp.read().decode("utf-8"))
+    entries = payload.get("queries", [])
+    if args.json:
+        print(json.dumps(entries, indent=2, default=str))
+        return 0
+    for e in entries:
+        flags = []
+        if e.get("error"):
+            flags.append("ERROR")
+        if e.get("partialResult"):
+            flags.append("PARTIAL")
+        if e.get("trace") is not None:
+            flags.append("TRACED")
+        print(
+            f"{e.get('timeMs', 0):>10.3f} ms  rows={e.get('rows', 0):<8} "
+            f"docs={e.get('numDocsScanned', 0):<10} qid={e.get('queryId')} "
+            f"fp={e.get('planFingerprint')} {' '.join(flags)}  {e.get('sql', '')}"
+        )
+    print(f"-- {len(entries)} entr(y/ies)", file=sys.stderr)
+    return 0
+
+
 def cmd_lint(args) -> int:
     """JAX-aware static lint (analysis/repo_lint.py) over the package tree
     or explicit paths; exit 1 when findings exist so CI can gate on it."""
@@ -172,6 +202,12 @@ def main(argv=None) -> int:
 
     qs = sub.add_parser("quickstart", help="in-memory demo table + example queries")
     qs.set_defaults(fn=cmd_quickstart)
+
+    sq = sub.add_parser("slow-queries", help="print a serving endpoint's recent/slow query log")
+    sq.add_argument("--url", default="http://127.0.0.1:8099", help="query server base URL")
+    sq.add_argument("--limit", type=int, default=20)
+    sq.add_argument("--json", action="store_true", help="dump raw entries as JSON")
+    sq.set_defaults(fn=cmd_slow_queries)
 
     lt = sub.add_parser("lint", help="JAX-aware static lint over the pinot_tpu tree")
     lt.add_argument("paths", nargs="*", help="python files to lint (default: the installed package)")

@@ -1,57 +1,168 @@
 """Process-wide metrics registry (ServerMetrics/BrokerMetrics analog,
-pinot-common/.../metrics/ — meters, gauges and timers keyed by name).
+pinot-common/.../metrics/ — meters, gauges, timers and histograms keyed by
+name).
 
-Re-design: one lock-free-enough registry of counters/gauges/timers with a
+Re-design: one registry of counters/gauges/timers/histograms with a
 snapshot() export instead of yammer/dropwizard plumbing; emitters call
 METRICS.counter("queries").inc() on the hot path (dict lookups only).
+
+Thread-safety contract: REST handler threads and concurrent scatter calls
+mutate the same metric objects, so every read-modify-write holds that
+metric's own lock (a bare `+=` on an attribute is NOT atomic in CPython),
+and snapshot() copies the name->metric maps under the registry lock before
+reading each metric under its own — a snapshot taken mid-traffic is
+internally consistent per metric and never races a concurrent register.
+
+Exposure formats: snapshot() is the JSON surface (/metrics); to_prometheus()
+renders the same registry as Prometheus text exposition 0.0.4 for
+`GET /metrics?format=prometheus` (histograms as cumulative `_bucket{le=...}`
+series the way promhttp would).
 """
 from __future__ import annotations
 
+import bisect
+import re
 import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional, Tuple
 
 
 class Counter:
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self) -> None:
         self.value = 0
+        self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Gauge:
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self) -> None:
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self.value = v
+        # single attribute store: atomic under the GIL, no lock needed
+        self.value = float(v)
+
+    def add(self, delta: float) -> None:
+        """Locked increment for gauges tracking a live count (in-flight
+        scatters, pinned bytes) where += would lose concurrent updates."""
+        with self._lock:
+            self.value += float(delta)
 
 
 class Timer:
-    """Count + total + max milliseconds (the useful aggregate slice of a
-    latency histogram without per-query allocation)."""
+    """Count + total + max milliseconds (the cheap aggregate slice when a
+    full histogram is overkill — latency-critical paths use Histogram)."""
 
-    __slots__ = ("count", "total_ms", "max_ms")
+    __slots__ = ("count", "total_ms", "max_ms", "_lock")
 
     def __init__(self) -> None:
         self.count = 0
         self.total_ms = 0.0
         self.max_ms = 0.0
+        self._lock = threading.Lock()
 
     def update(self, ms: float) -> None:
-        self.count += 1
-        self.total_ms += ms
-        if ms > self.max_ms:
-            self.max_ms = ms
+        with self._lock:
+            self.count += 1
+            self.total_ms += ms
+            if ms > self.max_ms:
+                self.max_ms = ms
 
     @property
     def mean_ms(self) -> float:
         return self.total_ms / self.count if self.count else 0.0
+
+    def _snap(self) -> Dict[str, Any]:
+        with self._lock:
+            mean = self.total_ms / self.count if self.count else 0.0
+            return {"count": self.count, "meanMs": mean, "maxMs": self.max_ms}
+
+
+# log-spaced millisecond bucket upper bounds: 0.1ms .. ~52s, doubling —
+# the same scale promhttp's ExponentialBuckets(0.1, 2, 20) would pick for a
+# query-latency histogram (sub-ms kernel launches up to deadline-scale tails)
+_HIST_BOUNDS_MS: Tuple[float, ...] = tuple(0.1 * (2.0 ** k) for k in range(20))
+
+
+class Histogram:
+    """Fixed log-spaced ms buckets + count/sum/max/min; p50/p95/p99 come from
+    a cumulative bucket walk with linear interpolation inside the bucket (the
+    HdrHistogram-lite answer — a few percent of bucket width, allocation-free
+    on the update path)."""
+
+    __slots__ = ("bounds", "counts", "count", "sum_ms", "max_ms", "min_ms", "_lock")
+
+    def __init__(self, bounds: Tuple[float, ...] = _HIST_BOUNDS_MS) -> None:
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # last slot = +Inf overflow
+        self.count = 0
+        self.sum_ms = 0.0
+        self.max_ms = 0.0
+        self.min_ms = float("inf")
+        self._lock = threading.Lock()
+
+    def update(self, ms: float) -> None:
+        i = bisect.bisect_left(self.bounds, ms)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum_ms += ms
+            if ms > self.max_ms:
+                self.max_ms = ms
+            if ms < self.min_ms:
+                self.min_ms = ms
+
+    def _quantile_locked(self, q: float) -> float:
+        """Caller holds self._lock."""
+        if not self.count:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if not c:
+                continue
+            prev_cum = cum
+            cum += c
+            if cum >= target:
+                if i >= len(self.bounds):
+                    return self.max_ms  # overflow bucket: best bound we have
+                lo = self.bounds[i - 1] if i else 0.0
+                hi = self.bounds[i]
+                frac = (target - prev_cum) / c
+                return min(lo + (hi - lo) * frac, self.max_ms)
+        return self.max_ms
+
+    def _snap(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "count": self.count,
+                "meanMs": self.sum_ms / self.count if self.count else 0.0,
+                "maxMs": self.max_ms,
+                "minMs": self.min_ms if self.count else 0.0,
+                "p50Ms": self._quantile_locked(0.50),
+                "p95Ms": self._quantile_locked(0.95),
+                "p99Ms": self._quantile_locked(0.99),
+            }
+
+    def buckets(self) -> List[Tuple[float, int]]:
+        """Cumulative (upper_bound_ms, count<=bound) pairs, +Inf last —
+        exactly the Prometheus histogram series shape."""
+        with self._lock:
+            out: List[Tuple[float, int]] = []
+            cum = 0
+            for b, c in zip(self.bounds, self.counts):
+                cum += c
+                out.append((b, cum))
+            out.append((float("inf"), self.count))
+            return out
 
 
 class MetricsRegistry:
@@ -60,6 +171,7 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._timers: Dict[str, Timer] = {}
+        self._histograms: Dict[str, Histogram] = {}
 
     def counter(self, name: str) -> Counter:
         c = self._counters.get(name)
@@ -82,65 +194,143 @@ class MetricsRegistry:
                 t = self._timers.setdefault(name, Timer())
         return t
 
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram())
+        return h
+
+    def _copies(self):
+        """Stable name->metric copies: concurrent registration must never
+        blow up the snapshot iteration (dict-changed-size)."""
+        with self._lock:
+            return (
+                dict(self._counters),
+                dict(self._gauges),
+                dict(self._timers),
+                dict(self._histograms),
+            )
+
     def snapshot(self) -> Dict[str, Any]:
+        counters, gauges, timers, hists = self._copies()
         return {
-            "counters": {k: c.value for k, c in self._counters.items()},
-            "gauges": {k: g.value for k, g in self._gauges.items()},
-            "timers": {
-                k: {"count": t.count, "meanMs": t.mean_ms, "maxMs": t.max_ms}
-                for k, t in self._timers.items()
-            },
+            "counters": {k: c.value for k, c in counters.items()},
+            "gauges": {k: g.value for k, g in gauges.items()},
+            "timers": {k: t._snap() for k, t in timers.items()},
+            "histograms": {k: h._snap() for k, h in hists.items()},
         }
+
+    def to_prometheus(self, prefix: str = "pinot") -> str:
+        """Prometheus text exposition 0.0.4 of the whole registry."""
+        counters, gauges, timers, hists = self._copies()
+        lines: List[str] = []
+        for name, c in sorted(counters.items()):
+            full = f"{prefix}_{_prom_name(name)}_total"
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {c.value}")
+        for name, g in sorted(gauges.items()):
+            full = f"{prefix}_{_prom_name(name)}"
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {_prom_num(g.value)}")
+        for name, t in sorted(timers.items()):
+            full = f"{prefix}_{_prom_name(name)}_ms"
+            s = t._snap()
+            lines.append(f"# TYPE {full} summary")
+            lines.append(f"{full}_sum {_prom_num(s['count'] * s['meanMs'])}")
+            lines.append(f"{full}_count {s['count']}")
+        for name, h in sorted(hists.items()):
+            full = f"{prefix}_{_prom_name(name)}_ms"
+            lines.append(f"# TYPE {full} histogram")
+            for bound, cum in h.buckets():
+                le = "+Inf" if bound == float("inf") else f"{bound:g}"
+                lines.append(f'{full}_bucket{{le="{le}"}} {cum}')
+            with h._lock:
+                total, count = h.sum_ms, h.count
+            lines.append(f"{full}_sum {_prom_num(total)}")
+            lines.append(f"{full}_count {count}")
+        return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._timers.clear()
+            self._histograms.clear()
+
+
+def _prom_name(name: str) -> str:
+    s = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return s if re.match(r"[a-zA-Z_:]", s) else "_" + s
+
+
+def _prom_num(v: float) -> str:
+    return f"{v:g}"
 
 
 METRICS = MetricsRegistry()
 
 
 class Span:
-    """One trace span (RequestContext/tracing analog, SURVEY.md 5.1)."""
+    """One trace span (RequestContext/tracing analog, SURVEY.md 5.1).
 
-    __slots__ = ("name", "start", "duration_ms", "children")
+    `attrs` carry bounded-cardinality annotations (segment counts, docs
+    scanned, scan backend, retry round, breaker state, fault events) that
+    ride the span instead of exploding into metric names.  `children` may
+    hold Span objects or already-rendered span dicts — a server-built
+    subtree grafts into the broker trace as a dict."""
 
-    def __init__(self, name: str):
+    __slots__ = ("name", "start", "duration_ms", "children", "attrs")
+
+    def __init__(self, name: str, attrs: Optional[Dict[str, Any]] = None):
         self.name = name
         self.start = time.perf_counter()
         self.duration_ms = 0.0
-        self.children: List["Span"] = []
+        self.children: List[Any] = []  # Span | dict
+        self.attrs: Dict[str, Any] = dict(attrs) if attrs else {}
+
+    def annotate(self, **kw: Any) -> None:
+        self.attrs.update(kw)
 
     def close(self) -> None:
         self.duration_ms = (time.perf_counter() - self.start) * 1000
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"name": self.name, "ms": round(self.duration_ms, 3)}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
         if self.children:
-            d["children"] = [c.to_dict() for c in self.children]
+            d["children"] = [c if isinstance(c, dict) else c.to_dict() for c in self.children]
         return d
 
 
 class Trace:
     """Span-tree builder: `with trace.span("plan"): ...`; no-ops when
-    disabled so the hot path pays one attribute check."""
+    disabled so the hot path pays one attribute check.
 
-    def __init__(self, enabled: bool = False):
+    Distributed propagation: the broker mints the query id on the root span
+    (`query_id=`), each server builds its own Trace (root="server:<name>")
+    and ships the finished dict back in ExecutionStats.trace; the broker
+    grafts that subtree under its per-server span via `graft()` — one tree
+    per query across the whole scatter."""
+
+    def __init__(self, enabled: bool = False, root: str = "query", query_id: Optional[str] = None):
         self.enabled = enabled
-        self.root = Span("query") if enabled else None
+        self.root = Span(root) if enabled else None
+        if self.root is not None and query_id is not None:
+            self.root.attrs["queryId"] = query_id
         self._stack = [self.root] if enabled else []
 
     class _Ctx:
-        def __init__(self, trace: "Trace", name: str):
+        def __init__(self, trace: "Trace", name: str, attrs: Optional[Dict[str, Any]] = None):
             self.trace = trace
             self.name = name
+            self.attrs = attrs
             self.sp = None
 
         def __enter__(self):
             if self.trace.enabled:
-                self.sp = Span(self.name)
+                self.sp = Span(self.name, self.attrs)
                 self.trace._stack[-1].children.append(self.sp)
                 self.trace._stack.append(self.sp)
             return self.sp
@@ -151,8 +341,19 @@ class Trace:
                 self.trace._stack.pop()
             return False
 
-    def span(self, name: str) -> "Trace._Ctx":
-        return Trace._Ctx(self, name)
+    def span(self, name: str, **attrs: Any) -> "Trace._Ctx":
+        return Trace._Ctx(self, name, attrs or None)
+
+    def annotate(self, **kw: Any) -> None:
+        """Attach attrs to the innermost open span (no-op when disabled)."""
+        if self.enabled:
+            self._stack[-1].annotate(**kw)
+
+    def graft(self, subtree: Optional[Dict[str, Any]]) -> None:
+        """Append an already-rendered span dict (a server's finished trace)
+        as a child of the innermost open span."""
+        if self.enabled and subtree:
+            self._stack[-1].children.append(subtree)
 
     def finish(self):
         if self.root is not None:

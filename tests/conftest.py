@@ -32,3 +32,13 @@ def pytest_configure(config):
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    """The METRICS registry is process-global; without a reset, counter and
+    histogram assertions see spill-over from whichever tests ran before."""
+    from pinot_tpu.utils.metrics import METRICS
+
+    METRICS.reset()
+    yield

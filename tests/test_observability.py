@@ -1,0 +1,403 @@
+"""Observability surface: trace trees under injected faults, latency
+histograms, Prometheus exposition, the slow-query log and EXPLAIN ANALYZE.
+
+The trace assertions pin the PR's acceptance shape: one span tree per query
+with the broker scatter, per-round failover, per-server execute (grafted
+server subtree with dispatch/device_wait/collect) all visible, durations
+non-zero where work happened.
+"""
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, Coordinator, FaultPlan, ServerInstance
+from pinot_tpu.cluster.rest import QueryServer
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.config import TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+from pinot_tpu.utils.metrics import METRICS, Histogram, MetricsRegistry
+from pinot_tpu.utils.slowlog import SlowQueryLog
+
+
+def _schema():
+    return Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+
+
+def _data(n, seed):
+    rng = np.random.default_rng(seed)
+    return {
+        "city": rng.choice(["sf", "nyc", "la"], n).astype(object),
+        "v": rng.integers(0, 100, n),
+    }
+
+
+def _engine(n_segments=3, rows=200):
+    eng = QueryEngine()
+    eng.register_table(_schema())
+    for i in range(n_segments):
+        eng.add_segment("t", build_segment(_schema(), _data(rows, 100 + i), f"seg{i}"))
+    return eng
+
+
+def _cluster(n_servers=2, replication=2, n_segments=4, rows=200):
+    coord = Coordinator(replication=replication)
+    for i in range(n_servers):
+        coord.register_server(ServerInstance(f"server{i}"))
+    coord.add_table(_schema(), TableConfig(name="t"))
+    for i in range(n_segments):
+        coord.add_segment("t", build_segment(_schema(), _data(rows, 100 + i), f"seg{i}"))
+    return coord
+
+
+def _spans(node, out=None):
+    """Flatten a span tree into {name: [node, ...]}."""
+    if out is None:
+        out = {}
+    out.setdefault(node["name"], []).append(node)
+    for c in node.get("children", []):
+        _spans(c, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Histogram + registry
+# ---------------------------------------------------------------------------
+class TestHistogram:
+    def test_quantiles_bracket_the_data(self):
+        h = Histogram()
+        for ms in range(1, 101):  # 1..100 ms, ~uniform
+            h.update(float(ms))
+        s = h._snap()
+        assert s["count"] == 100
+        assert s["minMs"] == 1.0 and s["maxMs"] == 100.0
+        # log-bucketed interpolation: a few percent of bucket width
+        assert 30 <= s["p50Ms"] <= 70
+        assert 75 <= s["p95Ms"] <= 100
+        assert s["p95Ms"] <= s["p99Ms"] <= 100
+
+    def test_buckets_are_cumulative_and_end_at_inf(self):
+        h = Histogram()
+        for ms in (0.05, 1.0, 10.0, 1e9):  # below first bound + overflow
+            h.update(ms)
+        b = h.buckets()
+        assert b[-1][0] == float("inf") and b[-1][1] == 4
+        counts = [c for _, c in b]
+        assert counts == sorted(counts), "bucket counts must be cumulative"
+
+    def test_empty_histogram_snapshots_zeros(self):
+        s = Histogram()._snap()
+        assert s == {
+            "count": 0, "meanMs": 0.0, "maxMs": 0.0, "minMs": 0.0,
+            "p50Ms": 0.0, "p95Ms": 0.0, "p99Ms": 0.0,
+        }
+
+    def test_concurrent_updates_are_exact(self):
+        reg = MetricsRegistry()
+        n, threads = 2000, 8
+
+        def work():
+            for i in range(n):
+                reg.counter("c").inc()
+                reg.histogram("h").update(float(i % 50))
+                reg.gauge("g").add(1.0)
+
+        ts = [threading.Thread(target=work) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        snap = reg.snapshot()
+        assert snap["counters"]["c"] == n * threads
+        assert snap["histograms"]["h"]["count"] == n * threads
+        assert snap["gauges"]["g"] == float(n * threads)
+
+    def test_snapshot_during_concurrent_registration(self):
+        reg = MetricsRegistry()
+        stop = threading.Event()
+        errors = []
+
+        def register():
+            i = 0
+            while not stop.is_set():
+                reg.counter(f"series{i % 500}").inc()
+                i += 1
+
+        def snap():
+            try:
+                for _ in range(200):
+                    reg.snapshot()
+                    reg.to_prometheus()
+            except Exception as e:  # pragma: no cover - the failure under test
+                errors.append(e)
+
+        reg_t = threading.Thread(target=register)
+        snap_t = threading.Thread(target=snap)
+        reg_t.start()
+        snap_t.start()
+        snap_t.join()
+        stop.set()
+        reg_t.join()
+        assert errors == []
+
+
+class TestPrometheusExposition:
+    def test_counter_gauge_histogram_render(self):
+        reg = MetricsRegistry()
+        reg.counter("broker.queries").inc(3)
+        reg.gauge("broker.openBreakers").set(1)
+        reg.timer("plan").update(2.0)
+        for ms in (0.5, 5.0, 500.0):
+            reg.histogram("queryLatency").update(ms)
+        text = reg.to_prometheus()
+        lines = text.splitlines()
+        assert "pinot_broker_queries_total 3" in lines
+        assert "pinot_broker_openBreakers 1" in lines
+        assert "# TYPE pinot_queryLatency_ms histogram" in lines
+        assert 'pinot_queryLatency_ms_bucket{le="+Inf"} 3' in lines
+        assert "pinot_queryLatency_ms_count 3" in lines
+        assert any(l.startswith("pinot_queryLatency_ms_sum ") for l in lines)
+        assert "pinot_plan_ms_count 1" in lines
+        # bucket series are monotone non-decreasing
+        cums = [
+            int(l.rsplit(" ", 1)[1])
+            for l in lines
+            if l.startswith("pinot_queryLatency_ms_bucket")
+        ]
+        assert cums == sorted(cums)
+
+    def test_names_are_sanitized(self):
+        reg = MetricsRegistry()
+        reg.counter("server.segmentBytes.my-table").inc()
+        assert "pinot_server_segmentBytes_my_table_total 1" in reg.to_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# Trace propagation
+# ---------------------------------------------------------------------------
+class TestEngineTrace:
+    def test_device_host_split_spans(self):
+        eng = _engine()
+        res = eng.query("SET trace = true; SELECT city, COUNT(*) FROM t GROUP BY city")
+        spans = _spans(res.stats.trace)
+        assert res.stats.query_id and res.stats.query_id.startswith("engine_")
+        assert spans["query"][0]["attrs"]["queryId"] == res.stats.query_id
+        dw = spans["device_wait"][0]
+        assert dw["attrs"]["launches"] == 3
+        assert len([n for n in spans if n.startswith("launch:")]) == 3
+        assert len(spans["collect"]) == 3
+
+    def test_untraced_query_has_no_id_overhead_fields(self):
+        eng = _engine()
+        res = eng.query("SELECT COUNT(*) FROM t")
+        assert res.stats.trace is None
+        assert res.stats.query_id is not None  # id minted regardless
+
+
+class TestBrokerFaultTrace:
+    def test_single_tree_with_failover_rounds(self):
+        """One server killed mid-scatter: the finished trace is ONE tree
+        holding the broker scatter, both rounds, the failed server_execute
+        (error + breaker state) and each surviving server's grafted subtree
+        with dispatch/device_wait/collect spans."""
+        coord = _cluster()
+        FaultPlan(seed=7).fail_server("server0", on_call=1).attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        res = broker.query("SET trace = true; SELECT city, COUNT(*), SUM(v) FROM t GROUP BY city")
+        tr = res.stats.trace
+        assert tr["name"] == "query"
+        assert tr["attrs"]["queryId"] == res.stats.query_id
+        spans = _spans(tr)
+        assert "scatter" in spans and "round:0" in spans and "round:1" in spans
+        execs = spans["server_execute"]
+        failed = [s for s in execs if "error" in s.get("attrs", {})]
+        assert len(failed) == 1
+        assert failed[0]["attrs"]["server"] == "server0"
+        assert "breaker" in failed[0]["attrs"]
+        # surviving calls graft the server-built subtree under themselves
+        ok = [s for s in execs if "error" not in s.get("attrs", {})]
+        assert ok, "at least one server call must succeed"
+        for s in ok:
+            sub = [c for c in s.get("children", []) if c["name"].startswith("server:")]
+            assert len(sub) == 1
+            sub_spans = _spans(sub[0])
+            assert "dispatch" in sub_spans
+            assert "device_wait" in sub_spans
+            assert "collect" in sub_spans
+            assert sub[0]["attrs"]["backend"]
+            assert sub[0]["ms"] > 0
+        assert spans["dispatch"][0]["ms"] > 0
+        assert tr["ms"] > 0
+
+    def test_breaker_and_inflight_gauges_published(self):
+        coord = _cluster()
+        FaultPlan(seed=7).fail_server("server0", on_call=1).attach(coord)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        broker.query("SELECT COUNT(*) FROM t")
+        snap = METRICS.snapshot()
+        assert "broker.openBreakers" in snap["gauges"]
+        assert "broker.breakerOpen.server0" in snap["gauges"]
+        assert snap["gauges"]["broker.inFlightScatters"] == 0.0
+        assert snap["histograms"]["broker.queryLatency"]["count"] == 1
+        assert snap["gauges"]["server.segmentBytes.t"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Slow-query log
+# ---------------------------------------------------------------------------
+class TestSlowQueryLog:
+    def test_ring_evicts_oldest(self):
+        log = SlowQueryLog(capacity=4, slow_ms=1e12)
+        for i in range(10):
+            log.record(f"SELECT {i}", f"fp{i}")
+        snap = log.snapshot()
+        assert len(log) == 4 and len(snap) == 4
+        assert [e["sql"] for e in snap] == ["SELECT 9", "SELECT 8", "SELECT 7", "SELECT 6"]
+
+    def test_trace_kept_only_over_threshold(self):
+        log = SlowQueryLog(capacity=8, slow_ms=50.0)
+
+        class R:
+            rows = [(1,)]
+
+            class stats:
+                time_ms = 0.0
+                query_id = "q"
+                num_docs_scanned = 1
+                num_segments_processed = 1
+                partial_result = False
+                exceptions = []
+                trace = {"name": "query", "ms": 1.0}
+
+        R.stats.time_ms = 10.0
+        fast = log.record("SELECT 1", "fp", R)
+        R.stats.time_ms = 90.0
+        slow = log.record("SELECT 2", "fp", R)
+        assert "trace" not in fast and slow["trace"]["name"] == "query"
+
+    def test_errors_are_logged_and_counted(self):
+        eng = _engine()
+        with pytest.raises(Exception):
+            eng.query("SELECT nope FROM t")
+        e = eng.slow_queries.snapshot(1)[0]
+        assert "error" in e and "nope" in e["sql"]
+        assert METRICS.snapshot()["counters"]["broker.slowQueries"] >= 1
+
+    def test_engine_records_every_query_newest_first(self):
+        eng = _engine()
+        eng.query("SELECT COUNT(*) FROM t")
+        eng.query("SELECT SUM(v) FROM t")
+        snap = eng.slow_queries.snapshot()
+        assert len(snap) == 2
+        assert "SUM" in snap[0]["sql"]  # newest first
+        assert snap[0]["queryId"].startswith("engine_")
+        assert snap[0]["rows"] == 1 and snap[0]["numDocsScanned"] > 0
+
+
+# ---------------------------------------------------------------------------
+# REST + CLI surface
+# ---------------------------------------------------------------------------
+class TestRestSurface:
+    @pytest.fixture()
+    def server(self):
+        srv = QueryServer(_engine()).start()
+        yield srv
+        srv.stop()
+
+    def _get(self, srv, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}") as r:
+            return r.headers.get("Content-Type", ""), r.read().decode("utf-8")
+
+    def _post(self, srv, sql):
+        body = json.dumps({"sql": sql}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/query/sql", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def test_prometheus_format_and_json_default(self, server):
+        self._post(server, "SELECT COUNT(*) FROM t")
+        ctype, text = self._get(server, "/metrics?format=prometheus")
+        assert ctype.startswith("text/plain")
+        assert "pinot_queries_total" in text
+        assert 'pinot_queryLatency_ms_bucket{le="+Inf"} 1' in text
+        ctype, body = self._get(server, "/metrics")
+        assert ctype.startswith("application/json")
+        snap = json.loads(body)
+        assert "counters" in snap and "histograms" in snap
+
+    def test_debug_queries_and_request_id(self, server):
+        resp = self._post(server, "SELECT COUNT(*) FROM t")
+        assert resp["requestId"].startswith("engine_")
+        _, body = self._get(server, "/debug/queries?limit=5")
+        entries = json.loads(body)["queries"]
+        assert entries and entries[0]["queryId"] == resp["requestId"]
+
+    def test_cli_slow_queries(self, server, capsys):
+        from pinot_tpu.tools.cli import main
+
+        self._post(server, "SELECT city, COUNT(*) FROM t GROUP BY city")
+        rc = main(["slow-queries", "--url", f"http://127.0.0.1:{server.port}", "--limit", "3"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "GROUP BY city" in out and "qid=engine_" in out
+        rc = main(["slow-queries", "--url", f"http://127.0.0.1:{server.port}", "--json"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE
+# ---------------------------------------------------------------------------
+class TestExplainAnalyze:
+    def test_engine_operator_rows_join_measured_ms(self):
+        eng = _engine()
+        res = eng.query("EXPLAIN ANALYZE SELECT city, SUM(v) FROM t WHERE city = 'sf' GROUP BY city")
+        assert res.columns == ["Operator", "Operator_Id", "Parent_Id", "Actual_Ms", "Rows"]
+        by_op = {r[0].split("(")[0]: r for r in res.rows if not r[0].startswith("TRACE")}
+        assert by_op["BROKER_REDUCE"][3] is not None and by_op["BROKER_REDUCE"][3] >= 0
+        assert by_op["GROUP_BY"][3] is not None and by_op["GROUP_BY"][3] > 0
+        assert by_op["FILTER_SCAN"][4] == res.stats.num_docs_scanned
+        trace_rows = [r for r in res.rows if r[0].startswith("TRACE")]
+        assert trace_rows, "measured span tree must follow the operator rows"
+        assert trace_rows[0][2] == 0  # trace root parented at the table root
+        assert any("device_wait" in r[0] for r in trace_rows)
+        # ids are unique and parents resolve
+        ids = [r[1] for r in res.rows]
+        assert len(ids) == len(set(ids))
+        assert all(r[2] in set(ids) | {0} for r in res.rows)
+
+    def test_broker_explain_analyze_executes_with_trace(self):
+        broker = Broker(_cluster())
+        res = broker.query("EXPLAIN ANALYZE SELECT COUNT(*) FROM t")
+        assert res.columns[3] == "Actual_Ms"
+        trace_rows = [r for r in res.rows if r[0].startswith("TRACE")]
+        assert any("server_execute" in r[0] for r in trace_rows)
+        assert any("scatter" in r[0] for r in trace_rows)
+        assert res.stats.num_docs_scanned > 0  # it really executed
+
+    def test_explain_plan_for_still_static(self):
+        eng = _engine()
+        res = eng.query("EXPLAIN PLAN FOR SELECT COUNT(*) FROM t")
+        assert res.columns == ["Operator", "Operator_Id", "Parent_Id"]
+        assert METRICS.snapshot()["counters"].get("docsScanned", 0) == 0
+
+    def test_explain_garbage_still_fails(self):
+        from pinot_tpu.sql.parser import SqlParseError
+
+        eng = _engine()
+        with pytest.raises(SqlParseError):
+            eng.query("EXPLAIN NONSENSE SELECT COUNT(*) FROM t")

@@ -311,6 +311,49 @@ class TestW006SwallowedClusterException:
         assert _rules(src, threaded=False) == []
 
 
+class TestW007UnboundedMetricName:
+    def test_flags_sql_in_counter_name(self):
+        src = """
+        def record(self, sql):
+            METRICS.counter(f"latency.{sql}").inc()
+        """
+        assert _rules(src) == ["W007"]
+
+    def test_flags_query_id_in_span_name(self):
+        src = """
+        def run(self, trace, query_id):
+            with trace.span(f"exec:{query_id}"):
+                pass
+        """
+        assert _rules(src) == ["W007"]
+
+    def test_flags_attribute_access_and_bare_id(self):
+        src = """
+        def run(self, ctx):
+            METRICS.histogram(f"lat.{ctx.fingerprint}").update(1)
+            METRICS.gauge(f"g.{id}").set(1)
+        """
+        assert _rules(src) == ["W007", "W007"]
+
+    def test_quiet_on_bounded_label_spaces(self):
+        src = """
+        def record(self, table, server, seg):
+            METRICS.gauge(f"server.segmentBytes.{table}").add(1)
+            METRICS.counter(f"broker.breakerOpen.{server}").inc()
+            with self.trace.span(f"launch:{seg.name}"):
+                pass
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_plain_string_names_and_non_sinks(self):
+        src = """
+        def record(self, sql):
+            METRICS.counter("broker.queries").inc()
+            log(f"ran {sql}")  # not a metric/span name sink
+        """
+        assert _rules(src) == []
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

@@ -83,8 +83,9 @@ class TestMetricsAndTrace:
         snap = METRICS.snapshot()
         assert snap["counters"]["queries"] == 2
         assert snap["counters"]["docsScanned"] == 30000
-        assert snap["timers"]["queryLatency"]["count"] == 2
-        assert snap["timers"]["queryLatency"]["maxMs"] > 0
+        assert snap["histograms"]["queryLatency"]["count"] == 2
+        assert snap["histograms"]["queryLatency"]["maxMs"] > 0
+        assert snap["histograms"]["queryLatency"]["p99Ms"] > 0
 
     def test_trace_spans(self):
         eng = _engine()

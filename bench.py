@@ -124,6 +124,34 @@ def main() -> None:
     e2e = float(np.min(e2e_ts))
     lat = METRICS.snapshot()["histograms"]["dist.queryLatency"]
 
+    # ---- distinct-literal sweep ---------------------------------------
+    # Round-6 tentpole proof: N same-shape queries differing only in the
+    # filter literal must share ONE compiled kernel — the plan cache keys
+    # on the shape fingerprint (literals canonicalized to parameter slots)
+    # and the literal rides in as a device argument.  Before
+    # parameterization each literal was a fresh trace+compile.
+    from pinot_tpu.analysis.compile_audit import DIST_AUDIT
+
+    DIST_AUDIT.reset()
+    sweep_n = int(os.environ.get("BENCH_SWEEP", 20))
+    sweep_ts = []
+    for i in range(sweep_n):
+        q = parse_query(
+            "SELECT lo_orderdate, SUM(lo_revenue) FROM lineorder "
+            f"WHERE lo_quantity < {5 + (i % 40)} GROUP BY lo_orderdate LIMIT 2500"
+        )
+        t0 = time.perf_counter()
+        engine.execute(q)
+        sweep_ts.append(time.perf_counter() - t0)
+    sweep_compiles = sum(DIST_AUDIT.counts().values())
+    sweep = {
+        "queries": sweep_n,
+        "compiles": sweep_compiles,
+        "cache_hit_rate": round((sweep_n - sweep_compiles) / sweep_n, 3),
+        "warm_p50_ms": round(float(np.median(sweep_ts)) * 1000, 3),
+        "warm_p50_rows_per_sec": round(n / float(np.median(sweep_ts)), 1),
+    }
+
     # ---- per-stage trace summary --------------------------------------
     # one traced run (separate plan-cache entry: options ride the
     # fingerprint); per-stage ms aggregated by span base name
@@ -259,6 +287,7 @@ def main() -> None:
                     "max": round(lat["maxMs"], 3),
                 },
                 "trace_stage_ms": stage_ms,
+                "distinct_literal_sweep": sweep,
                 "rows": n,
                 "filter_index_uses": index_uses,
                 "cpu_proxy_rows_per_sec": round(_cpu_proxy(), 1),

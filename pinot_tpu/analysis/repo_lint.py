@@ -1,6 +1,6 @@
 """JAX-aware repo lint: ast pass over the pinot_tpu tree.
 
-Seven rules, each targeting an anti-pattern this codebase has actually
+Eight rules, each targeting an anti-pattern this codebase has actually
 been bitten by (ADVICE r5) or that silently degrades TPU throughput:
 
   W001 float-literal-in-jit   bare float literal used in arithmetic or a
@@ -39,6 +39,13 @@ been bitten by (ADVICE r5) or that silently degrades TPU throughput:
                               explosion in the registry and any scraper.
                               Bounded label spaces (table, segment, server
                               names) interpolate freely.
+  W008 literal-in-plan-key    a full `.fingerprint()` (which bakes predicate
+                              literals) used as a *plan-cache* key — every
+                              distinct literal recompiles the same kernel
+                              shape.  Plan caches must key on
+                              `.shape_fingerprint()` (query/shape.py), which
+                              canonicalizes literals into parameter slots.
+                              Result caches and logs keep the full form.
 
 Kernel bodies (W001/W002 scope) are functions the module jits: decorated
 with @jax.jit / @partial(jax.jit, ...) or passed by name to jax.jit(...)
@@ -68,6 +75,7 @@ RULES: Dict[str, str] = {
     "W005": "wall-clock time.time() in elapsed-time math (use monotonic/perf_counter)",
     "W006": "except block in cluster/ swallows the exception without recording it",
     "W007": "metric/span name interpolates an unbounded value (cardinality explosion)",
+    "W008": "literal-baked fingerprint() used as a plan-cache key (use shape_fingerprint)",
 }
 
 _HOST_SYNC_ATTRS = frozenset({"item", "block_until_ready", "device_get", "tolist"})
@@ -505,6 +513,96 @@ def _check_w007(path: str, tree: ast.AST, findings: List[Finding]) -> None:
                     break
 
 
+def _contains_fingerprint_call(node: ast.AST) -> bool:
+    """An expression containing a `.fingerprint()` call — the FULL form that
+    bakes literal values.  `.shape_fingerprint()` is a different attribute
+    and deliberately does not match."""
+    for n in ast.walk(node):
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "fingerprint"
+        ):
+            return True
+    return False
+
+
+def _is_plan_cache_name(node: ast.AST) -> bool:
+    """A name/attribute that IS a plan cache by repo convention
+    (`_PLAN_CACHE`, `self._plan_cache`, ...).  Result caches, slow logs and
+    audit maps legitimately hold full fingerprints and never match."""
+    name = node.attr if isinstance(node, ast.Attribute) else (
+        node.id if isinstance(node, ast.Name) else None
+    )
+    return name is not None and "plan_cache" in name.lower()
+
+
+def _check_w008(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """Literal-baked plan-cache keys: `.fingerprint()` output reaching a
+    plan-cache subscript or .get/.put key, directly or via one local
+    assignment (`key = (ctx.fingerprint(), ...)` then `cache.get(key)`).
+    Every distinct literal then retraces an identical kernel shape — the
+    exact recompile storm shape_fingerprint() exists to prevent."""
+
+    def scan_scope(body: List[ast.stmt]) -> None:
+        nodes: List[ast.AST] = []
+        stack: List[ast.AST] = list(body)
+        while stack:
+            n = stack.pop()
+            nodes.append(n)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # nested scope: its own pass, its own taints
+            stack.extend(ast.iter_child_nodes(n))
+        tainted: Set[str] = set()
+        for n in nodes:
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and _contains_fingerprint_call(n.value)
+            ):
+                tainted.add(n.targets[0].id)
+
+        def literal_bearing(expr: ast.AST) -> bool:
+            return _contains_fingerprint_call(expr) or (
+                isinstance(expr, ast.Name) and expr.id in tainted
+            )
+
+        for n in nodes:
+            if (
+                isinstance(n, ast.Subscript)
+                and _is_plan_cache_name(n.value)
+                and literal_bearing(n.slice)
+            ):
+                findings.append(
+                    Finding(
+                        path, n.lineno, "W008",
+                        "literal-baked fingerprint() in plan-cache key — "
+                        "key on shape_fingerprint() so literals parameterize",
+                    )
+                )
+            elif (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("get", "put", "setdefault", "pop")
+                and _is_plan_cache_name(n.func.value)
+                and n.args
+                and literal_bearing(n.args[0])
+            ):
+                findings.append(
+                    Finding(
+                        path, n.lineno, "W008",
+                        "literal-baked fingerprint() in plan-cache key — "
+                        "key on shape_fingerprint() so literals parameterize",
+                    )
+                )
+
+    scan_scope(getattr(tree, "body", []))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_scope(node.body)
+
+
 def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> List[Finding]:
     """Lint one module's source.  `threaded` enables the cluster/-scoped
     rules (W004 shared-state races, W006 swallowed exceptions)."""
@@ -529,6 +627,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
     _check_sync_in_loop(path, tree, findings)
     _check_w005(path, tree, findings)
     _check_w007(path, tree, findings)
+    _check_w008(path, tree, findings)
     if threaded:
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)

@@ -236,7 +236,48 @@ class Broker:
         self._broker_id = f"{random.getrandbits(32):08x}"
         # recent-query ring buffer behind GET /debug/queries + cli slow-queries
         self.slow_queries = SlowQueryLog()
+        # broker result cache: bytes-bounded LRU + TTL, keyed on the resolved
+        # query fingerprint + a table version token (segment set + realtime
+        # doc count), so segment churn or realtime appends miss naturally.
+        # Serving from it is opt-in: the useResultCache query option or the
+        # PINOT_TPU_RESULT_CACHE env toggle (off by default — repeated
+        # execution semantics stay untouched unless asked for).
+        import os
+
+        from pinot_tpu.utils.cache import LruCache
+
+        self.result_cache = LruCache(
+            max_bytes=max(1, int(os.environ.get("PINOT_TPU_RESULT_CACHE_BYTES", str(64 << 20)))),
+            ttl_s=float(os.environ.get("PINOT_TPU_RESULT_CACHE_TTL_S", "60")),
+            name="broker.resultCache",
+        )
         coordinator.on_live_change(self._on_live_change)
+
+    @staticmethod
+    def _result_cache_enabled(ctx: QueryContext) -> bool:
+        import os
+
+        opt = ctx.options.get("useResultCache")
+        if opt is not None:
+            return str(opt).lower() in ("1", "true", "yes")
+        return os.environ.get("PINOT_TPU_RESULT_CACHE", "0").lower() in ("1", "true", "yes")
+
+    def _table_version(self, table: str) -> Tuple:
+        """Version token invalidating cached results on table churn: the
+        offline segment set plus the realtime view's (segments, docs)."""
+        meta = self.coordinator.tables.get(table)
+        ideal = tuple(sorted(meta.ideal)) if meta is not None else ()
+        rt = self.coordinator.realtime.get(table)
+        rtv: Tuple = ()
+        if rt is not None:
+            segs = list(rt.query_segments())
+            rtv = (len(segs), sum(s.num_docs for s in segs))
+        return (ideal, rtv)
+
+    def invalidate_results(self, table: str) -> int:
+        """Explicitly drop every cached result for one table (segment
+        reload / config change hook)."""
+        return self.result_cache.invalidate_where(lambda k: k[0] == table)
 
     def _on_live_change(self, name: str, up: bool) -> None:
         """Coordinator live-set transition: a recovered server gets a fresh
@@ -349,12 +390,15 @@ class Broker:
         if ctx.options.get("__explain__"):
             return self.execute(ctx)  # plan-only: not a served query
         fp = ctx.fingerprint()
+        sfp = ctx.shape_fingerprint()
         try:
             out = self.execute(ctx)
         except Exception as e:
-            self.slow_queries.record(sql, fp, None, error=f"{type(e).__name__}: {e}")
+            self.slow_queries.record(
+                sql, fp, None, error=f"{type(e).__name__}: {e}", shape_fingerprint=sfp
+            )
             raise
-        self.slow_queries.record(sql, fp, out)
+        self.slow_queries.record(sql, fp, out, shape_fingerprint=sfp)
         return out
 
     def execute(self, ctx: QueryContext, _charged: frozenset = frozenset()) -> ResultTable:
@@ -391,14 +435,37 @@ class Broker:
         qid = f"{self._broker_id}_{next(self._qid_seq)}"
         trace = Trace(bool(ctx.options.get("trace", False)), query_id=qid)
         METRICS.counter("broker.queries").inc()
+        # result cache lookup: key on the post-resolution fingerprint +
+        # table version token, BEFORE plan-time option injection mutates
+        # ctx.  Traced queries bypass it (a cached result carries no spans).
+        ckey = None
+        if self._result_cache_enabled(ctx) and not ctx.options.get("trace", False):
+            ckey = (table, ctx.fingerprint(), self._table_version(table))
+            hit = self.result_cache.get(ckey)
+            if hit is not None:
+                import copy
+
+                out = copy.deepcopy(hit)
+                out.stats.time_ms = (time.perf_counter() - t0) * 1000
+                out.stats.query_id = qid
+                out.stats.result_cache = "hit"
+                METRICS.histogram("broker.queryLatency").update(out.stats.time_ms)
+                return out
         # schema-aware static validation before scatter: a malformed plan
         # fails ONCE at the broker with a structured error instead of
         # failing per-server inside jit tracing
         from pinot_tpu.analysis.plan_check import check_plan
 
-        with trace.span("plan"):
+        with trace.span("plan") as bsp:
             check_plan(ctx, self.coordinator.tables[table].schema)
             self._inject_global_ranges(ctx, table)
+            if bsp is not None:
+                from pinot_tpu.query.shape import shape_digest
+
+                bsp.annotate(
+                    shapeFp=shape_digest(ctx.shape_fingerprint()),
+                    resultCache="bypass" if ckey is None else "miss",
+                )
         # hybrid tables (offline segments + a realtime manager under ONE
         # name): a TIME BOUNDARY splits the parts — offline answers
         # ts <= boundary, realtime answers ts > boundary (TimeBoundaryManager
@@ -463,6 +530,14 @@ class Broker:
         tr = trace.finish()
         if tr is not None:
             out.stats.trace = tr
+        if ckey is not None:
+            out.stats.result_cache = "miss"
+            # complete answers only: degraded or exception-bearing results
+            # must re-execute, never replay
+            if not out.stats.partial_result and not out.stats.exceptions:
+                import copy
+
+                self.result_cache.put(ckey, copy.deepcopy(out))
         METRICS.histogram("broker.queryLatency").update(out.stats.time_ms)
         return out
 

@@ -167,10 +167,13 @@ class MultiStageEngine:
             from pinot_tpu.parallel.mesh import default_mesh
 
             mesh = default_mesh(axis)
+        from pinot_tpu.query.planner import _plan_cache_entries
+        from pinot_tpu.utils.cache import LruCache
+
         self.mesh = mesh
         self.axis = axis
         self.tables: Dict[str, Any] = tables if tables is not None else {}
-        self._plan_cache: Dict[Tuple, _MsePlan] = {}
+        self._plan_cache = LruCache(max_entries=_plan_cache_entries(), name="compile.mse")
 
     @property
     def num_devices(self) -> int:
@@ -230,11 +233,21 @@ class MultiStageEngine:
     # ------------------------------------------------------------------
     def _plan(self, ctx: QueryContext) -> _MsePlan:
         from pinot_tpu.analysis.compile_audit import MSE_AUDIT
+        from pinot_tpu.query.shape import column_info_from, params_structure
 
         rq = resolve(ctx, self.tables)
         strategy = self._strategy(ctx, rq)
+
+        def _info(name: str):
+            # column shapes resolve through the owning table (join queries
+            # span several); unknown columns bake their literals into the key
+            t = getattr(rq, "owner", {}).get(name)
+            if t is None or t not in self.tables:
+                return None
+            return column_info_from(self.tables[t])(name)
+
         key = (
-            rq.ctx.fingerprint(),
+            rq.ctx.shape_fingerprint(_info),
             tuple(self.tables[t].signature() for t in [rq.fact] + [j.table for j in rq.joins]),
             strategy,
             self.axis,
@@ -242,11 +255,19 @@ class MultiStageEngine:
         )
         cached = self._plan_cache.get(key)
         if cached is not None:
-            MSE_AUDIT.record_hit(key[0])
-            return cached
+            # rebind literals into a fresh plan around the cached jitted
+            # kernel; a params-structure mismatch means the shape audit was
+            # wrong for this query — count it as the compile it would be
+            plan = self._build_plan(rq, strategy, compiled_fn=cached.fn)
+            if (
+                params_structure(plan.params) == params_structure(cached.params)
+                and plan.sharded_by_ns == cached.sharded_by_ns
+            ):
+                MSE_AUDIT.record_hit(key[0])
+                return plan
         MSE_AUDIT.record_compile(key[0])
         plan = self._build_plan(rq, strategy)
-        self._plan_cache[key] = plan
+        self._plan_cache.put(key, plan)
         return plan
 
     def _strategy(self, ctx: QueryContext, rq: ResolvedQuery) -> str:
@@ -427,7 +448,9 @@ class MultiStageEngine:
         raise NotImplementedError(f"group-by on dimension column {expr.op} (type/range unsupported)")
 
     # ------------------------------------------------------------------
-    def _build_plan(self, rq: ResolvedQuery, strategy: str) -> _MsePlan:
+    def _build_plan(
+        self, rq: ResolvedQuery, strategy: str, compiled_fn: Optional[Callable] = None
+    ) -> _MsePlan:
         ctx = rq.ctx
         axis = self.axis
         ndev = self.num_devices
@@ -872,7 +895,7 @@ class MultiStageEngine:
             )
             return kern(fact_cols, fact_valid, tuple(dim_cols_list), tuple(dim_valids), params)
 
-        fn = jax.jit(run)
+        fn = compiled_fn if compiled_fn is not None else jax.jit(run)
         return _MsePlan(
             kind=kind,
             fn=fn,

@@ -202,10 +202,17 @@ class DistributedEngine:
             from pinot_tpu.parallel.mesh import default_mesh
 
             mesh = default_mesh(axis)
+        from pinot_tpu.query.planner import _plan_cache_entries
+        from pinot_tpu.utils.cache import LruCache
+
         self.mesh = mesh
         self.axis = axis
         self.tables: Dict[str, Any] = {}  # name -> StackedTable
-        self._plan_cache: Dict[Tuple, _DistPlan] = {}
+        self._plan_cache = LruCache(max_entries=_plan_cache_entries(), name="compile.dist")
+        # shape fp + hit/miss of the most recent _plan call (trace/EXPLAIN
+        # ANALYZE annotation; the engine plans one query at a time)
+        self._last_shape_fp: str = ""
+        self._last_plan_cache_hit = False
         # per-device bytes one launch may capture (macro-batching threshold);
         # ~2GB leaves the while-loop capture copy well under HBM headroom
         self.launch_bytes = (
@@ -280,8 +287,15 @@ class DistributedEngine:
             num_docs_scanned=stacked.num_docs,
             total_docs=stacked.num_docs,
         )
-        with trace.span("plan"):
+        with trace.span("plan") as psp:
             plan = self._plan(ctx, stacked)
+            if psp is not None:
+                from pinot_tpu.query.shape import shape_digest
+
+                psp.annotate(
+                    shapeFp=shape_digest(self._last_shape_fp),
+                    planCache="hit" if self._last_plan_cache_hit else "miss",
+                )
         stats.add_index_uses(plan.index_uses)
         with trace.span("run"):
             result = self._run(ctx, plan, stacked, stats, trace)
@@ -320,20 +334,41 @@ class DistributedEngine:
     def _plan(self, ctx: QueryContext, stacked) -> _DistPlan:
         from pinot_tpu.analysis.compile_audit import DIST_AUDIT
         from pinot_tpu.analysis.plan_check import check_plan_cached
+        from pinot_tpu.query.shape import column_info_from, params_structure
 
         check_plan_cached(ctx)
         batch_docs, batch_offsets = self._batching(ctx, stacked)
+        # Keyed on the SHAPE fingerprint: predicate literals canonicalize to
+        # parameter slots (query/shape.py), so 20 distinct-literal variants of
+        # one query share this entry and only rebind params below.
         key = (
-            ctx.fingerprint(), stacked.signature(), self.axis, self.num_devices, batch_docs,
+            ctx.shape_fingerprint(column_info_from(stacked)),
+            stacked.signature(), self.axis, self.num_devices, batch_docs,
             ops.scan_backend(),  # pallas/xla plans trace different kernels
         )
+        self._last_shape_fp = key[0]
         cached = self._plan_cache.get(key)
         if cached is not None:
-            DIST_AUDIT.record_hit(key[0])
-            return cached
+            # Rebind this query's literals into a fresh plan that reuses the
+            # cached compiled kernel (and device merge fn).  The structure
+            # check guards against an audit miss: a jitted fn silently
+            # retraces on a different params pytree, so a mismatch is a
+            # compile and must be counted (and cached) as one.
+            plan = self._build_plan(
+                ctx, stacked, batch_docs, batch_offsets,
+                compiled_fn=cached.fn, compiled_merge_fn=cached.sparse_merge_fn,
+            )
+            if (
+                params_structure(plan.params) == params_structure(cached.params)
+                and plan.row_sharded_params == cached.row_sharded_params
+            ):
+                DIST_AUDIT.record_hit(key[0])
+                self._last_plan_cache_hit = True
+                return plan
         DIST_AUDIT.record_compile(key[0])
+        self._last_plan_cache_hit = False
         plan = self._build_plan(ctx, stacked, batch_docs, batch_offsets)
-        self._plan_cache[key] = plan
+        self._plan_cache.put(key, plan)
         return plan
 
     def _batching(self, ctx: QueryContext, stacked) -> Tuple[int, Tuple[Tuple[int, int], ...]]:
@@ -388,6 +423,8 @@ class DistributedEngine:
         stacked,
         batch_docs: int,
         batch_offsets: Tuple[Tuple[int, int], ...],
+        compiled_fn: Optional[Callable] = None,
+        compiled_merge_fn: Optional[Callable] = None,
     ) -> _DistPlan:
         axis = self.axis
         ndev = self.num_devices
@@ -616,7 +653,9 @@ class DistributedEngine:
                         uniq, parts, num_slots, field_ops, order_spec=morder
                     )
 
-                sparse_merge_fn = jax.jit(_merge)
+                sparse_merge_fn = (
+                    compiled_merge_fn if compiled_merge_fn is not None else jax.jit(_merge)
+                )
 
         else:  # selection
 
@@ -675,7 +714,9 @@ class DistributedEngine:
             )
             return kern(cols, params)
 
-        fn = jax.jit(run)
+        # On a shape-cache hit the caller passes the already-jitted kernel:
+        # this rebuild only re-derives params/metadata, never re-traces.
+        fn = compiled_fn if compiled_fn is not None else jax.jit(run)
 
         needed = sse_executor_needed_columns(ctx, stacked)
         # index-resolved filter columns never ship to device (the bitmap/doc
@@ -715,22 +756,30 @@ class DistributedEngine:
 
     def device_batches(self, plan: _DistPlan, stacked) -> List[Tuple[Dict, Dict]]:
         """Device-placed (cols, params) per macro-batch launch (bench.py's
-        marginal-timing hook shares this with _run)."""
+        marginal-timing hook shares this with _run).
+
+        Batch-invariant params stage ONCE per query: only the launch-schedule
+        scalars (__boff__/__fresh__) and the doc-sliced row-sharded bitmap
+        words differ between launches, so the shared device_put cost no
+        longer scales with the launch count."""
+        repl = NamedSharding(self.mesh, P())
+        shard = NamedSharding(self.mesh, P(self.axis, None))
+        shared = {
+            k: jax.device_put(v, repl)
+            for k, v in plan.params.items()
+            if k not in plan.row_sharded_params and k not in ("__boff__", "__fresh__")
+        }
         out = []
         for off, fresh in plan.batch_offsets:
             cols, _ = stacked.to_device(
                 self.mesh, self.axis, plan.needed_columns,
                 doc_slice=(off, off + plan.batch_docs), with_valid=False,
             )
-            params = {
-                k: jax.device_put(
-                    v,
-                    NamedSharding(
-                        self.mesh, P(self.axis, None) if k in plan.row_sharded_params else P()
-                    ),
-                )
-                for k, v in self.batch_params(plan, off, fresh).items()
-            }
+            params = dict(shared)
+            for k, v in self.batch_params(plan, off, fresh).items():
+                if k in shared:
+                    continue
+                params[k] = jax.device_put(v, shard if k in plan.row_sharded_params else repl)
             out.append((cols, params))
         return out
 

@@ -569,16 +569,25 @@ class FilterCompiler:
         self.used_columns.update(c for c in p.lhs.columns() if c != "*")
 
         if pt in (PredicateType.IN, PredicateType.NOT_IN):
+            from pinot_tpu.query.shape import bucket_size
+
             key = self._key("set")
             vals_arr = np.asarray(sorted(p.values))
-            if (
-                np.issubdtype(vals_arr.dtype, np.integer)
-                and vals_arr.dtype.itemsize > 4
-                and len(vals_arr)
-                and np.iinfo(np.int32).min <= vals_arr[0]
-                and vals_arr[-1] <= np.iinfo(np.int32).max
-            ):
-                vals_arr = vals_arr.astype(np.int32)
+            # numeric lists: normalize dtype (a value-dependent downcast
+            # would make the traced program depend on the literals) and pad
+            # to the bucketed size class with identity fill — repeating a
+            # member never changes isin semantics, and distinct list
+            # lengths within one bucket share a single compile
+            # (shape-fingerprint contract, query/shape.py).
+            if np.issubdtype(vals_arr.dtype, np.integer):
+                vals_arr = vals_arr.astype(np.int64)
+            elif np.issubdtype(vals_arr.dtype, np.floating):
+                vals_arr = vals_arr.astype(np.float64)
+            if vals_arr.dtype.kind in "iuf" and len(vals_arr):
+                b = bucket_size(len(vals_arr))
+                if b > len(vals_arr):
+                    fill = np.full(b - len(vals_arr), vals_arr[0], vals_arr.dtype)
+                    vals_arr = np.concatenate([vals_arr, fill])
             self.params[key] = vals_arr
 
             def eval_in(cols, params, _key=key, _neg=(pt is PredicateType.NOT_IN)):
@@ -593,18 +602,47 @@ class FilterCompiler:
 
             return eval_in
 
+        # raw EQ/NEQ/RANGE: numeric literals ship as scalar params, so
+        # distinct literals replay one traced program (the shape-
+        # fingerprint contract).  Which bounds exist and their inclusivity
+        # stay trace-time structure — exactly what query/shape.py keeps in
+        # the slot.  Non-numeric literals remain trace-time constants (the
+        # audit keeps those predicates literal-keyed).
+        def _num_param(suffix: str, v):
+            if not isinstance(v, (bool, int, float)):
+                return None
+            key = self._key(suffix)
+            if isinstance(v, bool):
+                self.params[key] = np.bool_(v)
+            elif isinstance(v, int):
+                self.params[key] = np.int64(v)
+            else:
+                self.params[key] = np.float64(v)
+            return key
+
+        eq_key = lo_key = hi_key = None
+        if pt in (PredicateType.EQ, PredicateType.NEQ):
+            eq_key = _num_param("cmp", p.values[0])
+        elif pt is PredicateType.RANGE:
+            if p.lower is not None:
+                lo_key = _num_param("lo", p.lower)
+            if p.upper is not None:
+                hi_key = _num_param("hi", p.upper)
+
         def eval_cmp(cols, params):
             vals, nulls = eval_expr(p.lhs, seg, cols)
             if pt is PredicateType.EQ:
-                t = vals == p.values[0]
+                t = vals == (params[eq_key] if eq_key is not None else p.values[0])
             elif pt is PredicateType.NEQ:
-                t = vals != p.values[0]
+                t = vals != (params[eq_key] if eq_key is not None else p.values[0])
             elif pt is PredicateType.RANGE:
                 t = jnp.ones_like(vals, dtype=bool)
                 if p.lower is not None:
-                    t = t & (vals >= p.lower if p.lower_inclusive else vals > p.lower)
+                    lo = params[lo_key] if lo_key is not None else p.lower
+                    t = t & (vals >= lo if p.lower_inclusive else vals > lo)
                 if p.upper is not None:
-                    t = t & (vals <= p.upper if p.upper_inclusive else vals < p.upper)
+                    hi = params[hi_key] if hi_key is not None else p.upper
+                    t = t & (vals <= hi if p.upper_inclusive else vals < hi)
             else:
                 raise ValueError(f"predicate {pt} unsupported on raw values")
             if nulls is not None and null_handling:

@@ -408,6 +408,16 @@ class QueryContext:
             out.append(alias if alias else str(s))
         return out
 
+    def shape_fingerprint(self, column_info=None) -> str:
+        """Literal-canonicalized fingerprint for compile caches: queries
+        that differ only in parameterizable predicate literals share one
+        key (query/shape.py holds the per-predicate audit).  `column_info`
+        is a per-table metadata provider (shape.column_info_from); without
+        it every filter literal conservatively stays in the key."""
+        from pinot_tpu.query.shape import shape_fingerprint
+
+        return shape_fingerprint(self, column_info)
+
     def fingerprint(self) -> str:
         parts = [
             self.table,

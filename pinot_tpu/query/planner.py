@@ -143,8 +143,24 @@ class SegmentPlan:
     index_uses: List[Tuple[str, str]] = field(default_factory=list)
 
 
-# jit cache: (query fingerprint, segment signature) -> (fn, plan metadata)
-_PLAN_CACHE: Dict[Tuple[str, Tuple], SegmentPlan] = {}
+# jit cache: (query SHAPE fingerprint, segment signature, backend) -> plan.
+# Shape-keyed (query/shape.py): literals ride the params pytree, so distinct
+# literals of one query shape share a single traced program.  Bounded LRU —
+# an unbounded plan cache under shape churn (many distinct query shapes) is
+# a slow memory leak; eviction only drops OUR reference, XLA's own
+# executable cache keeps the compiled artifact reusable.
+_PLAN_CACHE_ENTRIES = 512  # override: PINOT_TPU_PLAN_CACHE_ENTRIES
+
+
+def _plan_cache_entries() -> int:
+    import os
+
+    return int(os.environ.get("PINOT_TPU_PLAN_CACHE_ENTRIES", _PLAN_CACHE_ENTRIES))
+
+
+from pinot_tpu.utils.cache import LruCache  # noqa: E402  (after np/jax imports)
+
+_PLAN_CACHE: LruCache = LruCache(max_entries=_plan_cache_entries(), name="compile.sse")
 
 
 def plan_cache_clear() -> None:
@@ -888,24 +904,30 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     from pinot_tpu.analysis.compile_audit import SSE_AUDIT
     from pinot_tpu.analysis.plan_check import check_plan_cached
 
+    from pinot_tpu.query.shape import column_info_from, params_structure
+
     # static IR validation before anything traces: malformed plans raise
     # structured PlanCheckError here instead of a tracer error inside jit
     check_plan_cached(ctx)
     needed = _needed_columns(ctx, segment)
     key = (
-        ctx.fingerprint(),
+        ctx.shape_fingerprint(column_info_from(segment)),
         _segment_signature(segment, needed, sketch_bound_columns(ctx) | const_bound_columns(ctx)),
         ops.scan_backend(),  # pallas/xla plans trace different kernels
     )
     cached = _PLAN_CACHE.get(key)
     if cached is not None:
-        # params are per-segment (dictionary-dependent): rebuild them, reuse fn
-        SSE_AUDIT.record_hit(key[0])
+        # params are per-query/per-segment (literals, dictionary lookups):
+        # rebuild them, reuse the compiled fn.  The structure check is the
+        # safety net under the shape audit — a mismatch would silently
+        # retrace, so it counts (and compiles) as a miss instead.
         plan = _build_plan(ctx, segment, needed, compiled_fn=cached.fn)
-        return plan
+        if params_structure(plan.params) == params_structure(cached.params):
+            SSE_AUDIT.record_hit(key[0])
+            return plan
     SSE_AUDIT.record_compile(key[0])
     plan = _build_plan(ctx, segment, needed, compiled_fn=None)
-    _PLAN_CACHE[key] = plan
+    _PLAN_CACHE.put(key, plan)
     return plan
 
 

@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from pinot_tpu.query.functions import combine_field, field_identity, for_spec
+from pinot_tpu.query.functions import FIELD_COMBINE, combine_field, field_identity, for_spec
 from pinot_tpu.query.ir import (
     AggregationSpec,
     Expr,
@@ -306,7 +306,20 @@ def _decode_dense_keys(group_dims, present: np.ndarray) -> List[np.ndarray]:
 
 
 def _hash_merge(results: List[GroupBySegmentResult], aggs) -> Tuple[List[np.ndarray], List[Dict[str, np.ndarray]]]:
-    """Generic keyed merge (IndexedTable upsert analog)."""
+    """Generic keyed merge (IndexedTable upsert analog).
+
+    Fast path: key tuples encode to dense int codes (np.unique per dim) and
+    every partial field combines with ONE ufunc scatter (the FIELD_COMBINE
+    name contract the dense/psum merges already rely on) — no per-row Python
+    upsert.  First-seen key order is preserved.  Pairwise-merge aggregations
+    (coupled fields) and incomparable mixed-type keys fall back to the loop."""
+    if all(
+        not fn.pairwise_merge and all(f in FIELD_COMBINE for f in results[0].partials[ai])
+        for ai, fn in enumerate(aggs)
+    ):
+        merged = _hash_merge_vectorized(results, aggs)
+        if merged is not None:
+            return merged
     table: Dict[tuple, List[Dict[str, Any]]] = {}
     for r in results:
         n = len(r.keys[0]) if r.keys else 0
@@ -327,6 +340,77 @@ def _hash_merge(results: List[GroupBySegmentResult], aggs) -> Tuple[List[np.ndar
     for ai, fn in enumerate(aggs):
         fields = results[0].partials[ai].keys()
         partials_out.append({f: np.asarray([table[k][ai][f] for k in all_keys]) for f in fields})
+    return keys_out, partials_out
+
+
+def _scatter_init(shape, dtype, op: str):
+    """Identity-filled accumulator for one ufunc-scatter combine; every group
+    has at least one row, so the identity never reaches the output."""
+    if op == "add":
+        return np.zeros(shape, dtype=dtype)
+    if np.issubdtype(dtype, np.floating):
+        fill = np.inf if op == "min" else -np.inf
+    elif dtype == np.bool_:
+        fill = op == "min"
+    else:
+        info = np.iinfo(dtype)
+        fill = info.max if op == "min" else info.min
+    return np.full(shape, fill, dtype=dtype)
+
+
+def _hash_merge_vectorized(results: List[GroupBySegmentResult], aggs):
+    """Returns (keys, partials) in first-seen key order, or None when the
+    keys defy np.unique coding (caller falls back to the upsert loop)."""
+    ndims = len(results[0].keys)
+    total = sum(len(r.keys[0]) if r.keys else 0 for r in results)
+    if total == 0 or ndims == 0:
+        return None
+    cat_keys = [
+        np.concatenate([np.asarray(r.keys[d], dtype=object) for r in results])
+        for d in range(ndims)
+    ]
+    cards, invs = [], []
+    for d in range(ndims):
+        try:
+            uniq, inv = np.unique(cat_keys[d], return_inverse=True)
+        except TypeError:
+            return None
+        cards.append(max(1, len(uniq)))
+        invs.append(inv.reshape(-1))
+    space = 1
+    for c in cards:
+        space *= c
+    if space >= (1 << 62):  # packed composite code must fit int64
+        return None
+    codes = np.zeros(total, dtype=np.int64)
+    for card, inv in zip(cards, invs):
+        codes = codes * np.int64(card) + inv.astype(np.int64)
+    uniq_codes, first_pos, inv = np.unique(codes, return_index=True, return_inverse=True)
+    order = np.argsort(first_pos, kind="stable")  # sorted-unique -> first-seen
+    rank = np.empty(len(uniq_codes), dtype=np.int64)
+    rank[order] = np.arange(len(uniq_codes))
+    g = rank[inv.reshape(-1)]  # row -> output slot
+    k = len(uniq_codes)
+    keys_out = [cat_keys[d][first_pos[order]] for d in range(ndims)]
+    partials_out: List[Dict[str, np.ndarray]] = []
+    for ai in range(len(aggs)):
+        out: Dict[str, np.ndarray] = {}
+        for f in results[0].partials[ai]:
+            arr = np.concatenate(
+                [np.atleast_1d(np.asarray(r.partials[ai][f])) for r in results]
+            )
+            if arr.dtype == object:
+                return None  # non-numeric partials: upsert loop path
+            op = FIELD_COMBINE[f]
+            acc = _scatter_init((k,) + arr.shape[1:], arr.dtype, op)
+            if op == "add":
+                np.add.at(acc, g, arr)
+            elif op == "min":
+                np.minimum.at(acc, g, arr)
+            else:
+                np.maximum.at(acc, g, arr)
+            out[f] = acc
+        partials_out.append(out)
     return keys_out, partials_out
 
 
@@ -598,9 +682,42 @@ def _rows_from_columns(cols: Sequence[np.ndarray], n: int) -> List[tuple]:
     return rows
 
 
+def _order_codes(order_by: List[OrderByExpr], ord_vals: List[np.ndarray], n: int):
+    """Vectorized rank keys for _sorted_order's lexsort fast path: each
+    column codes to float ranks via np.unique over the RAW objects (python
+    `<` ordering, so strings and numbers alike match the comparator), nulls
+    to +-inf per nulls placement.  Returns None when a column defies
+    total-order coding (mixed incomparable types, NaN) — the caller falls
+    back to the Python comparator."""
+    keys = []
+    for ob, vals in zip(reversed(order_by), reversed(ord_vals)):
+        a = np.asarray(vals, dtype=object)
+        isnull = np.fromiter((v is None for v in a), dtype=bool, count=len(a))
+        body = a[~isnull]
+        k = np.empty(n, dtype=np.float64)
+        if body.size:
+            if any(isinstance(v, (float, np.floating)) and math.isnan(v) for v in body):
+                return None
+            try:
+                _, inv = np.unique(body, return_inverse=True)
+            except TypeError:
+                return None
+            num = inv.reshape(-1).astype(np.float64)
+            k[~isnull] = num if ob.ascending else -num
+        k[isnull] = np.inf if ob.nulls_last else -np.inf
+        keys.append(k)
+    return keys
+
+
 def _sorted_order(order_by: List[OrderByExpr], ord_vals: List[np.ndarray], n: int) -> List[int]:
     """Stable index sort honoring asc/desc + nulls placement, robust to
     mixed/None/object values (python comparison semantics)."""
+    if n > 1:
+        keys = _order_codes(order_by, ord_vals, n)
+        if keys is not None:
+            # np.lexsort is stable, so equal-ranked rows keep their original
+            # order — the same i - j tiebreak the comparator applies
+            return list(np.lexsort(tuple(keys)))
 
     def cmp(i: int, j: int) -> int:
         for ob, vals in zip(order_by, ord_vals):

@@ -51,6 +51,7 @@ class SlowQueryLog:
         result=None,
         query_id: Optional[str] = None,
         error: Optional[str] = None,
+        shape_fingerprint: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Log one finished (or failed) query; returns the entry dict."""
         stats = getattr(result, "stats", None)
@@ -61,6 +62,13 @@ class SlowQueryLog:
             "queryId": query_id if query_id is not None else (stats.query_id if stats else None),
             "sql": sql,
             "planFingerprint": _fp_digest(fingerprint),
+            # literal-canonical shape digest: every member of a parameterized
+            # plan-cache family shares this value (query/shape.py)
+            "shapeFingerprint": _fp_digest(shape_fingerprint)
+            if shape_fingerprint is not None
+            else None,
+            # "hit" | "miss" when the broker result cache was consulted
+            "resultCache": getattr(stats, "result_cache", None) if stats else None,
             "timeMs": round(time_ms, 3),
             "rows": len(result.rows) if result is not None else 0,
             "numDocsScanned": stats.num_docs_scanned if stats else 0,

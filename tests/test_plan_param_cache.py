@@ -1,0 +1,287 @@
+"""Literal-parameterized plan cache: shape-fingerprint keys + device params.
+
+The tentpole contract: queries that differ ONLY in predicate literals
+share one compiled kernel — the plan cache keys on the shape fingerprint
+(literals canonicalized to parameter slots) and the literal values ride
+in as device arguments.  These tests prove three things:
+
+  * parity — a warm engine (cached plan, new params) returns bit-identical
+    results to a cold engine and to sqlite, across EQ/IN/RANGE/NOT_IN on
+    dict-encoded and raw columns, including NULLs and out-of-dictionary
+    literals;
+  * O(1) compiles — a 20-distinct-literal sweep records <= 2 compiles in
+    DIST_AUDIT (literal-keyed caching recorded 20);
+  * the broker result cache and the LRU primitive behave: hit/miss/
+    invalidation on realtime append, TTL, bytes bound, and thread safety.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from pinot_tpu.analysis.compile_audit import DIST_AUDIT
+from pinot_tpu.parallel.engine import DistributedEngine
+from pinot_tpu.parallel.stacked import StackedTable
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+from pinot_tpu.utils.cache import LruCache
+
+from golden import assert_same_rows, sqlite_from_data
+
+N = 4000
+CITIES = ["sf", "nyc", "chi", "la", "sea", "pdx"]
+
+
+def _schema():
+    return Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("year", DataType.INT),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("price", DataType.DOUBLE, role=FieldRole.METRIC, nullable=True),
+        ],
+    )
+
+
+@pytest.fixture(scope="module")
+def env():
+    rng = np.random.default_rng(23)
+    data = {
+        "city": rng.choice(CITIES, N).astype(object),
+        "year": rng.integers(2000, 2012, N).astype(np.int32),
+        "v": rng.integers(-100, 1000, N),
+        "price": np.where(rng.random(N) < 0.2, np.nan, np.round(rng.random(N) * 50, 3)),
+    }
+    st = StackedTable.build(_schema(), data, 8)
+    eng = DistributedEngine()
+    eng.register_table("t", st)
+    conn = sqlite_from_data("t", data)
+    return eng, st, conn
+
+
+# Literal families: every query in one family shares a shape, so on a warm
+# engine all but the first ride the cached compiled kernel with fresh
+# device parameters.  Families cover dict EQ (incl. the out-of-dictionary
+# literal 'zzz'), dict IN / NOT_IN (different set sizes pad to one
+# bucket), raw-numeric EQ / RANGE / IN, and a nullable raw RANGE.
+FAMILIES = [
+    [f"SELECT COUNT(*), SUM(v) FROM t WHERE city = '{c}'" for c in ("sf", "nyc", "la", "zzz")],
+    [
+        "SELECT city, SUM(v) FROM t WHERE city IN ('sf', 'nyc') GROUP BY city ORDER BY city",
+        "SELECT city, SUM(v) FROM t WHERE city IN ('la', 'chi', 'sea') GROUP BY city ORDER BY city",
+        "SELECT city, SUM(v) FROM t WHERE city IN ('pdx', 'zzz') GROUP BY city ORDER BY city",
+    ],
+    [
+        "SELECT COUNT(*) FROM t WHERE city NOT IN ('sf')",
+        "SELECT COUNT(*) FROM t WHERE city NOT IN ('nyc', 'la')",
+    ],
+    [f"SELECT COUNT(*), SUM(v) FROM t WHERE year = {y}" for y in (2003, 2011, 1999)],
+    [
+        f"SELECT year, COUNT(*) FROM t WHERE v BETWEEN {lo} AND {hi} "
+        "GROUP BY year ORDER BY year LIMIT 50"
+        for lo, hi in ((-50, 100), (0, 900), (500, 501))
+    ],
+    [
+        "SELECT SUM(v) FROM t WHERE year IN (2001, 2002)",
+        "SELECT SUM(v) FROM t WHERE year IN (2005, 2006, 2007, 2008)",
+    ],
+    [f"SELECT COUNT(price), SUM(v) FROM t WHERE price > {p}" for p in (10.5, 40.25, 49.9)],
+]
+
+
+class TestLiteralParity:
+    @pytest.mark.parametrize("family", FAMILIES, ids=lambda f: f[0][30:70])
+    def test_warm_engine_matches_sqlite_and_cold(self, env, family):
+        eng, st, conn = env
+        for sql in family:
+            warm = eng.query(sql)
+            cold_eng = DistributedEngine()
+            cold_eng.register_table("t", st)
+            cold = cold_eng.query(sql)
+            exp = conn.execute(sql).fetchall()
+            ordered = "ORDER BY" in sql
+            assert_same_rows(warm.rows, exp, ordered=ordered)
+            assert_same_rows(cold.rows, [tuple(r) for r in warm.rows], ordered=ordered)
+
+
+class TestRecompileCount:
+    def test_twenty_literal_sweep_compiles_at_most_twice(self, env):
+        eng, st, conn = env
+        sql_t = (
+            "SELECT year, COUNT(*), SUM(v) FROM t "
+            "WHERE v < {lit} GROUP BY year ORDER BY year LIMIT 50"
+        )
+        DIST_AUDIT.reset()
+        for i in range(20):
+            sql = sql_t.format(lit=-90 + i * 50)
+            got = eng.query(sql)
+            exp = conn.execute(sql).fetchall()
+            assert_same_rows(got.rows, exp, ordered=True)
+        assert sum(DIST_AUDIT.counts().values()) <= 2
+
+    def test_limit_is_parameterized_but_honored(self, env):
+        # LIMIT trims host-side -> rides a `?limit` slot, sharing one plan
+        eng, _, _ = env
+        DIST_AUDIT.reset()
+        r3 = eng.query("SELECT city, SUM(v) FROM t GROUP BY city LIMIT 3")
+        r4 = eng.query("SELECT city, SUM(v) FROM t GROUP BY city LIMIT 4")
+        assert len(r3.rows) == 3 and len(r4.rows) == 4
+        assert sum(DIST_AUDIT.counts().values()) <= 1
+
+    def test_structure_affecting_option_stays_in_key(self, env):
+        # maxDenseGroups flips the dense/sparse group-by plan -> fresh compile
+        eng, _, conn = env
+        sql = "SELECT city, COUNT(*) FROM t GROUP BY city ORDER BY city LIMIT 10"
+        DIST_AUDIT.reset()
+        dense = eng.query(sql)
+        sparse = eng.query("SET maxDenseGroups = 2; " + sql)
+        assert sum(DIST_AUDIT.counts().values()) >= 1  # sparse plan is its own entry
+        exp = conn.execute(sql).fetchall()
+        assert_same_rows(dense.rows, exp, ordered=True)
+        assert_same_rows(sparse.rows, exp, ordered=True)
+
+
+class TestBrokerResultCache:
+    def _realtime_cluster(self, tmp_path):
+        from pinot_tpu.cluster import Broker, Coordinator, ServerInstance
+        from pinot_tpu.realtime import InMemoryStream
+        from pinot_tpu.spi.config import SegmentsConfig, StreamConfig, TableConfig
+
+        coord = Coordinator(replication=1)
+        coord.register_server(ServerInstance("s0"))
+        stream = InMemoryStream(1)
+        cfg = TableConfig(
+            name="rt",
+            segments=SegmentsConfig(time_column="ts"),
+            stream=StreamConfig(stream_type="memory", max_rows_per_segment=1000),
+        )
+        schema = Schema(
+            "rt",
+            [
+                FieldSpec("city", DataType.STRING),
+                FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+            ],
+        )
+        coord.add_realtime_table(schema, cfg, str(tmp_path / "rt"), stream=stream)
+        return Broker(coord), coord, stream
+
+    SQL = "SET useResultCache = true; SELECT city, SUM(v) FROM rt GROUP BY city ORDER BY city"
+
+    def test_hit_then_invalidate_on_realtime_append(self, tmp_path):
+        broker, coord, stream = self._realtime_cluster(tmp_path)
+        t0 = 1_700_000_000_000
+        stream.publish_many(
+            [{"city": ["sf", "nyc"][i % 2], "v": i, "ts": t0 + i} for i in range(40)], partition=0
+        )
+        coord.run_realtime_consumption()
+
+        r1 = broker.query(self.SQL)
+        assert r1.stats.result_cache == "miss"
+        r2 = broker.query(self.SQL)
+        assert r2.stats.result_cache == "hit"
+        assert [tuple(r) for r in r2.rows] == [tuple(r) for r in r1.rows]
+
+        # realtime append changes the version token -> served fresh, not stale
+        stream.publish_many([{"city": "sf", "v": 1000, "ts": t0 + 100}], partition=0)
+        coord.run_realtime_consumption()
+        r3 = broker.query(self.SQL)
+        assert r3.stats.result_cache == "miss"
+        sf = dict((r[0], r[1]) for r in r3.rows)["sf"]
+        assert sf == dict((r[0], r[1]) for r in r1.rows)["sf"] + 1000
+
+    def test_explicit_invalidation_and_default_off(self, tmp_path):
+        broker, coord, stream = self._realtime_cluster(tmp_path)
+        stream.publish_many(
+            [{"city": "sf", "v": 1, "ts": 1_700_000_000_000}], partition=0
+        )
+        coord.run_realtime_consumption()
+        broker.query(self.SQL)
+        assert len(broker.result_cache) == 1
+        assert broker.invalidate_results("rt") == 1
+        assert broker.query(self.SQL).stats.result_cache == "miss"
+        # without the option the cache is never consulted
+        plain = broker.query("SELECT SUM(v) FROM rt")
+        assert getattr(plain.stats, "result_cache", None) is None
+
+
+class TestObservabilitySurfaces:
+    def test_dist_trace_plan_span_records_shape_fp_and_cache_hit(self, env):
+        eng, _, _ = env
+        sql = "SELECT city, SUM(v) FROM t GROUP BY city ORDER BY city LIMIT 10"
+        eng.query(sql)  # warm the cache
+        traced = eng.query("SET trace = true; " + sql)
+        plan_span = next(c for c in traced.stats.trace["children"] if c["name"] == "plan")
+        assert len(plan_span["attrs"]["shapeFp"]) == 12
+        assert plan_span["attrs"]["planCache"] == "hit"
+
+    def test_broker_explain_analyze_and_slowlog_record_fingerprint(self, tmp_path):
+        from pinot_tpu.cluster import Broker, Coordinator, ServerInstance
+        from pinot_tpu.segment.builder import build_segment
+
+        schema = Schema(
+            "o",
+            [FieldSpec("city", DataType.STRING), FieldSpec("v", DataType.LONG, role=FieldRole.METRIC)],
+        )
+        coord = Coordinator(replication=1)
+        coord.register_server(ServerInstance("s0"))
+        coord.add_table(schema)
+        rng = np.random.default_rng(5)
+        d = {"city": rng.choice(["sf", "nyc"], 300).astype(object), "v": rng.integers(0, 9, 300)}
+        coord.add_segment("o", build_segment(schema, d, "seg0"))
+        broker = Broker(coord)
+        res = broker.query("EXPLAIN ANALYZE SELECT city, SUM(v) FROM o GROUP BY city")
+        plan_rows = [r[0] for r in res.rows if r[0].startswith("TRACE(plan)")]
+        assert plan_rows and "shapeFp=" in plan_rows[0] and "resultCache=" in plan_rows[0]
+        broker.query("SET useResultCache = true; SELECT COUNT(*) FROM o")
+        entry = broker.slow_queries.snapshot()[0]
+        assert len(entry["shapeFingerprint"]) == 12
+        assert entry["resultCache"] == "miss"
+
+
+class TestLruCache:
+    def test_entry_bound_evicts_lru(self):
+        c = LruCache(max_entries=2)
+        c.put("a", 1)
+        c.put("b", 2)
+        assert c.get("a") == 1  # refresh a -> b is now LRU
+        c.put("c", 3)
+        assert "b" not in c and c.get("a") == 1 and c.get("c") == 3
+
+    def test_bytes_bound_and_oversize_never_admits(self):
+        c = LruCache(max_bytes=100, sizeof=lambda v: v)
+        c.put("big", 101)
+        assert "big" not in c
+        c.put("a", 60)
+        c.put("b", 60)  # evicts a
+        assert "a" not in c and "b" in c and c.bytes == 60
+
+    def test_ttl_expiry_with_injected_clock(self):
+        c = LruCache(max_entries=8, ttl_s=10.0)
+        now = [100.0]
+        c.clock = lambda: now[0]
+        c.put("k", "v")
+        assert c.get("k") == "v"
+        now[0] = 111.0
+        assert c.get("k") is None and len(c) == 0
+
+    def test_concurrent_get_put(self):
+        c = LruCache(max_entries=32)
+        errors = []
+
+        def hammer(tid):
+            try:
+                for i in range(500):
+                    c.put((tid, i % 50), i)
+                    c.get((tid, (i * 7) % 50))
+                    if i % 100 == 0:
+                        c.invalidate_where(lambda k: k[0] == tid and k[1] % 13 == 0)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(t,)) for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors and len(c) <= 32

@@ -354,6 +354,60 @@ class TestW007UnboundedMetricName:
         assert _rules(src) == []
 
 
+class TestW008LiteralFingerprintInPlanCacheKey:
+    def test_flags_fingerprint_in_cache_get_key(self):
+        src = """
+        def plan(self, ctx, seg):
+            return self._plan_cache.get((ctx.fingerprint(), seg.signature()))
+        """
+        assert _rules(src) == ["W008"]
+
+    def test_flags_fingerprint_via_key_alias(self):
+        src = """
+        def plan(ctx, seg):
+            key = (ctx.fingerprint(), seg.signature())
+            cached = _PLAN_CACHE.get(key)
+            return cached
+        """
+        assert _rules(src) == ["W008"]
+
+    def test_flags_subscript_store(self):
+        src = """
+        def plan(self, ctx, plan):
+            self._plan_cache[ctx.fingerprint()] = plan
+        """
+        assert _rules(src) == ["W008"]
+
+    def test_quiet_on_shape_fingerprint_key(self):
+        src = """
+        def plan(self, ctx, seg):
+            key = (ctx.shape_fingerprint(), seg.signature())
+            return self._plan_cache.get(key)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_non_plan_cache_sinks(self):
+        src = """
+        def execute(self, ctx, table):
+            ckey = (table, ctx.fingerprint())
+            hit = self.result_cache.get(ckey)
+            self.slow_queries.record(ctx.sql, ctx.fingerprint())
+            return hit
+        """
+        assert _rules(src) == []
+
+    def test_alias_in_other_scope_does_not_leak(self):
+        src = """
+        def make_key(ctx):
+            key = ctx.fingerprint()
+            return key
+
+        def plan(self, key):
+            return self._plan_cache.get(key)
+        """
+        assert _rules(src) == []
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

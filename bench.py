@@ -144,6 +144,10 @@ def main() -> None:
         engine.execute(q)
         sweep_ts.append(time.perf_counter() - t0)
     sweep_compiles = sum(DIST_AUDIT.counts().values())
+    # snapshot here so the audit covers exactly the sweep since reset():
+    # cold = first trace per shape, warm_recompiles = re-traces of a seen
+    # shape (a literal leaking into the plan key shows up here first)
+    plan_cache = DIST_AUDIT.summary()
     sweep = {
         "queries": sweep_n,
         "compiles": sweep_compiles,
@@ -288,6 +292,12 @@ def main() -> None:
                 },
                 "trace_stage_ms": stage_ms,
                 "distinct_literal_sweep": sweep,
+                "plan_cache": {
+                    "hits": plan_cache["hits"],
+                    "cold_compiles": plan_cache["cold_compiles"],
+                    "warm_recompiles": plan_cache["warm_recompiles"],
+                    "hit_rate": round(plan_cache["hit_rate"], 3),
+                },
                 "rows": n,
                 "filter_index_uses": index_uses,
                 "cpu_proxy_rows_per_sec": round(_cpu_proxy(), 1),

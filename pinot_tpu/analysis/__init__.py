@@ -1,17 +1,30 @@
-"""Static analysis: plan-time checks, repo lint, recompilation audit.
+"""Static analysis: plan-time checks, repo lint, interprocedural passes.
 
-Three cooperating passes that enforce staging-time invariants BEFORE any
-JAX tracing happens (DrJAX-style: MapReduce-shaped JAX programs stay fast
-only when static shapes / stable dtypes / no host sync hold at trace time):
+Cooperating passes that enforce staging-time invariants BEFORE any JAX
+tracing happens (DrJAX-style: MapReduce-shaped JAX programs stay fast
+only when static shapes / stable dtypes / no host sync hold at trace
+time):
 
   plan_check     - type/shape/dtype walker over the query IR; malformed
                    plans raise structured PlanCheckError instead of an
                    opaque tracer traceback from inside jax.jit.
-  repo_lint      - ast-based lint over the pinot_tpu tree for JAX
-                   anti-patterns (weak-type float literals in kernels,
-                   host<->device sync inside jitted code, jit-in-loop
-                   recompilation, unlocked shared-state RMW in threaded
-                   cluster classes).
+  repo_lint      - per-file ast lint over the pinot_tpu tree for JAX
+                   anti-patterns (W001-W008: weak-type float literals in
+                   kernels, host<->device sync inside jitted code,
+                   jit-in-loop recompilation, unlocked shared-state RMW,
+                   wall-clock latency math, swallowed cluster
+                   exceptions, unbounded metric names, literal-baked
+                   plan-cache keys).
+  engine         - interprocedural core: whole-package ASTs, symbol
+                   table, import resolution, call graph (callgraph.py),
+                   pass API, inline `# pinot-lint: disable=` handling
+                   and the committed baseline (baseline.json).
+  races          - lock-discipline race detector (W010 unguarded access
+                   to lock-guarded attrs, W011 lock-order cycles, W012
+                   blocking call while holding a lock).
+  device_sync    - host-device sync auditor (W013 implicit device->host
+                   syncs, W014 host branching on device values) on the
+                   warm query path.
   compile_audit  - fingerprint -> compile-event recorder wrapped around
                    the kernel caches; counters exported via utils.metrics
                    and a guard that flags recompilation storms.
@@ -22,6 +35,15 @@ from pinot_tpu.analysis.compile_audit import (
     SSE_AUDIT,
     CompileAudit,
     RecompilationStormError,
+)
+from pinot_tpu.analysis.engine import (
+    AnalysisReport,
+    Pass,
+    Project,
+    default_passes,
+    load_baseline,
+    run_passes,
+    run_project,
 )
 from pinot_tpu.analysis.plan_check import PlanCheckError, PlanIssue, check_plan, collect_issues
 from pinot_tpu.analysis.repo_lint import Finding, lint_paths, lint_source, lint_tree
@@ -35,6 +57,13 @@ __all__ = [
     "lint_source",
     "lint_paths",
     "lint_tree",
+    "AnalysisReport",
+    "Pass",
+    "Project",
+    "default_passes",
+    "load_baseline",
+    "run_passes",
+    "run_project",
     "CompileAudit",
     "RecompilationStormError",
     "SSE_AUDIT",

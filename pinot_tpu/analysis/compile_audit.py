@@ -14,7 +14,7 @@ from __future__ import annotations
 import os
 import threading
 import warnings
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 from pinot_tpu.utils.metrics import METRICS
 
@@ -42,6 +42,7 @@ class CompileAudit:
         )
         self._lock = threading.Lock()
         self._compiles: Dict[str, int] = {}
+        self._hits = 0
 
     def record_compile(self, fingerprint: str) -> None:
         """Record one cache-miss compile of `fingerprint` (call at jit time)."""
@@ -61,6 +62,8 @@ class CompileAudit:
             warnings.warn(msg, RuntimeWarning, stacklevel=3)
 
     def record_hit(self, fingerprint: str) -> None:
+        with self._lock:
+            self._hits += 1
         METRICS.counter(f"compile.{self.name}.hits").inc()
 
     def compile_count(self, fingerprint: str) -> int:
@@ -71,9 +74,33 @@ class CompileAudit:
         with self._lock:
             return dict(self._compiles)
 
+    def hit_count(self) -> int:
+        with self._lock:
+            return self._hits
+
+    def summary(self) -> Dict[str, Any]:
+        """Plan-cache effectiveness snapshot since the last reset():
+        cold_compiles = distinct shapes traced for the first time,
+        warm_recompiles = re-traces of an already-seen shape (structure
+        mismatch or cache eviction — the expensive kind a literal leak
+        causes), hits = warm-path cache hits, hit_rate over all lookups."""
+        with self._lock:
+            total = sum(self._compiles.values())
+            cold = len(self._compiles)
+            hits = self._hits
+        lookups = hits + total
+        return {
+            "hits": hits,
+            "compiles_total": total,
+            "cold_compiles": cold,
+            "warm_recompiles": total - cold,
+            "hit_rate": (hits / lookups) if lookups else 0.0,
+        }
+
     def reset(self) -> None:
         with self._lock:
             self._compiles.clear()
+            self._hits = 0
 
 
 # one audit per kernel cache: the SSE per-segment plan cache

@@ -76,6 +76,13 @@ RULES: Dict[str, str] = {
     "W006": "except block in cluster/ swallows the exception without recording it",
     "W007": "metric/span name interpolates an unbounded value (cardinality explosion)",
     "W008": "literal-baked fingerprint() used as a plan-cache key (use shape_fingerprint)",
+    # interprocedural passes (analysis/races.py, analysis/device_sync.py —
+    # run via analysis/engine.py over the whole package, not per-file):
+    "W010": "lock-guarded attribute read/written without holding its lock",
+    "W011": "lock-order cycle across lock acquisitions (deadlock risk)",
+    "W012": "blocking call (sleep/sync/socket/device put) while holding a lock",
+    "W013": "implicit device->host sync on the warm query path",
+    "W014": "host control flow branches on a device value in the warm path",
 }
 
 _HOST_SYNC_ATTRS = frozenset({"item", "block_until_ready", "device_get", "tolist"})
@@ -88,9 +95,27 @@ class Finding:
     line: int
     rule: str
     message: str
+    # optional enrichment from the interprocedural passes (analysis/engine.py):
+    # a fix hint and the enclosing symbol ("Class.method") — empty for the
+    # per-file rules so the greppable str() form stays byte-stable
+    hint: str = ""
+    symbol: str = ""
 
     def __str__(self) -> str:
-        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+        s = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            s += f" [fix: {self.hint}]"
+        return s
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+            "hint": self.hint,
+            "symbol": self.symbol,
+        }
 
 
 def _is_jit_func(node: ast.AST) -> bool:
@@ -603,6 +628,40 @@ def _check_w008(path: str, tree: ast.AST, findings: List[Finding]) -> None:
             scan_scope(node.body)
 
 
+_SUPPRESS_MARK = "pinot-lint:"
+
+
+def parse_suppressions(src: str) -> Dict[int, Optional[Set[str]]]:
+    """Per-line `# pinot-lint: disable=W0xx[,W0yy]` markers.
+
+    Returns {lineno: set of suppressed rule ids} — the value None means
+    every rule is suppressed on that line (`disable=all`).  Honored by the
+    per-file rules (lint_source) and the interprocedural passes (engine).
+    """
+    out: Dict[int, Optional[Set[str]]] = {}
+    for lineno, text in enumerate(src.splitlines(), start=1):
+        if _SUPPRESS_MARK not in text:
+            continue
+        tail = text.split(_SUPPRESS_MARK, 1)[1]
+        if "disable=" not in tail:
+            continue
+        spec = tail.split("disable=", 1)[1].split("#", 1)[0].strip()
+        if not spec:
+            continue
+        if spec.lower() == "all":
+            out[lineno] = None
+        else:
+            out[lineno] = {r.strip().upper() for r in spec.split(",") if r.strip()}
+    return out
+
+
+def is_suppressed(f: Finding, suppressions: Dict[int, Optional[Set[str]]]) -> bool:
+    rules = suppressions.get(f.line, "absent")
+    if rules == "absent":
+        return False
+    return rules is None or f.rule in rules
+
+
 def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> List[Finding]:
     """Lint one module's source.  `threaded` enables the cluster/-scoped
     rules (W004 shared-state races, W006 swallowed exceptions)."""
@@ -631,6 +690,9 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
     if threaded:
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)
+    suppressions = parse_suppressions(src)
+    if suppressions:
+        findings = [f for f in findings if not is_suppressed(f, suppressions)]
     return findings
 
 

@@ -114,9 +114,12 @@ class AdaptiveServerStats:
 
     def score(self, server: str) -> float:
         # unseen servers score best (explore), matching the reference's
-        # default-to-fallback behavior for servers without stats
-        lat = self.ewma_ms.get(server, 0.0)
-        return lat * (1.0 + self.in_flight.get(server, 0))
+        # default-to-fallback behavior for servers without stats; snapshot
+        # under the lock so a concurrent end() can't tear lat/in_flight
+        with self._lock:
+            lat = self.ewma_ms.get(server, 0.0)
+            in_flight = self.in_flight.get(server, 0)
+        return lat * (1.0 + in_flight)
 
     def punish(self, server: str, factor: float = 2.0, floor_ms: float = 50.0) -> None:
         """Failure feedback from the circuit-breaker path: a failed scatter
@@ -205,7 +208,8 @@ class ServerHealth:
                 self._probing.add(server)
 
     def consecutive_failures(self, server: str) -> int:
-        return self._consecutive.get(server, 0)
+        with self._lock:
+            return self._consecutive.get(server, 0)
 
     def reset(self, server: str) -> None:
         """Fresh slate on a coordinator live-set recovery (mark_up): the
@@ -309,6 +313,7 @@ class Broker:
         usable = {s for s in self.coordinator.live if s not in exclude}
         with self._rr_lock:
             self._rr += 1
+            rr = self._rr  # routing decisions below use this stable local
         if self.selector == "replicagroup":
             # strict replica-group: pick ONE group serving ALL segments
             groups: Dict[int, Set[str]] = {}
@@ -316,7 +321,7 @@ class Broker:
                 groups.setdefault(self.coordinator.replica_group[s], set()).add(s)
             order = sorted(groups)
             for gi in range(len(order)):
-                g = order[(self._rr + gi) % len(order)]
+                g = order[(rr + gi) % len(order)]
                 members = groups[g]
                 assign: Dict[str, List[str]] = {}
                 ok = True
@@ -344,10 +349,10 @@ class Broker:
                 # breaks exact ties so cold starts still spread
                 srv = min(
                     candidates,
-                    key=lambda s, i=i: (self.server_stats.score(s), (self._rr + i + candidates.index(s)) % len(candidates)),
+                    key=lambda s, i=i: (self.server_stats.score(s), (rr + i + candidates.index(s)) % len(candidates)),
                 )
             else:
-                srv = candidates[(self._rr + i) % len(candidates)]
+                srv = candidates[(rr + i) % len(candidates)]
             assign.setdefault(srv, []).append(seg)
         return (assign, unroutable) if partial_ok else assign
 

@@ -78,13 +78,20 @@ class Coordinator:
     def mark_down(self, name: str) -> None:
         """Liveness loss (Helix session expiry analog): external view drops
         the server; ideal state keeps it until rebalance repairs."""
-        if name in self.live:
+        with self._membership_lock:
+            was_live = name in self.live
             self.live.discard(name)
+        if was_live:
+            # listeners run outside the lock: they take their own locks
+            # (broker breaker reset) and must not order against membership
             self._notify_live(name, up=False)
 
     def mark_up(self, name: str) -> None:
-        if name in self.servers and name not in self.live:
-            self.live.add(name)
+        with self._membership_lock:
+            recovered = name in self.servers and name not in self.live
+            if recovered:
+                self.live.add(name)
+        if recovered:
             self._notify_live(name, up=True)
 
     # -- table CRUD ------------------------------------------------------
@@ -116,10 +123,12 @@ class Coordinator:
 
     def drop_table(self, name: str) -> None:
         meta = self.tables.pop(name)
-        for seg_name, servers in meta.ideal.items():
-            for s in servers:
-                if s in self.servers:
-                    self.servers[s].drop_segment(name, seg_name)
+        with self._membership_lock:
+            servers = dict(self.servers)
+        for seg_name, assigned in meta.ideal.items():
+            for s in assigned:
+                if s in servers:
+                    servers[s].drop_segment(name, seg_name)
 
     # -- segment registration + assignment -------------------------------
     def add_segment(self, table: str, segment: ImmutableSegment) -> List[str]:
@@ -128,8 +137,11 @@ class Coordinator:
         targets = self._assign(meta, segment.name)
         meta.ideal[segment.name] = set(targets)
         meta.segment_meta[segment.name] = self._seg_meta(segment)
+        with self._membership_lock:
+            servers = {s: self.servers[s] for s in targets}
         for s in targets:
-            self.servers[s].add_segment(table, segment)
+            # device placement (HBM pins) happens outside the lock
+            servers[s].add_segment(table, segment)
         return targets
 
     def _seg_meta(self, segment: ImmutableSegment) -> Dict:
@@ -157,24 +169,27 @@ class Coordinator:
     def _assign(self, meta: TableMeta, seg_name: str) -> List[str]:
         """Replica-group aware balanced placement: one server per replica
         group (replication R = R groups), least-loaded within the group."""
-        if not self.live:
+        with self._membership_lock:
+            live = set(self.live)
+            groups = dict(self.replica_group)
+        if not live:
             raise RuntimeError("no live servers to assign to")
-        loads = {s: 0 for s in self.live}
+        loads = {s: 0 for s in live}
         for segs in meta.ideal.values():
             for s in segs:
                 if s in loads:
                     loads[s] += 1
         out: List[str] = []
         for g in range(self.num_replica_groups):
-            members = [s for s in self.live if self.replica_group[s] == g]
+            members = [s for s in live if groups.get(s) == g]
             if not members:
                 continue
             out.append(min(members, key=lambda s: (loads[s], s)))
         # a replica group with zero live members can't host its copy — top up
         # replication from the remaining live servers (availability over
         # strict group placement, like the reference's non-strict fallback)
-        want = min(self.replication, len(self.live))
-        remaining = [s for s in self.live if s not in out]
+        want = min(self.replication, len(live))
+        remaining = [s for s in live if s not in out]
         while len(out) < want and remaining:
             pick = min(remaining, key=lambda s: (loads[s], s))
             remaining.remove(pick)
@@ -186,7 +201,9 @@ class Coordinator:
         """Ideal state filtered to LIVE servers — what the broker routes on
         (ExternalView analog)."""
         meta = self.tables[table]
-        return {seg: {s for s in servers if s in self.live} for seg, servers in meta.ideal.items()}
+        with self._membership_lock:
+            live = set(self.live)
+        return {seg: {s for s in servers if s in live} for seg, servers in meta.ideal.items()}
 
     # -- rebalance --------------------------------------------------------
     def rebalance(self, table: str, min_available_replicas: int = 1) -> Dict[str, int]:
@@ -197,23 +214,25 @@ class Coordinator:
         replicas are added (server.add_segment) BEFORE old ones drop."""
         meta = self.tables[table]
         moved = added = dropped = 0
+        with self._membership_lock:
+            live = set(self.live)
+            servers = dict(self.servers)
         for seg_name in list(meta.ideal):
             current = meta.ideal[seg_name]
-            live_now = {s for s in current if s in self.live}
             desired = set(self._assign_for_rebalance(meta, seg_name))
             if desired == current:
                 continue
-            segment = self._find_segment_object(table, seg_name, current | self.live)
+            segment = self._find_segment_object(table, seg_name, current | live)
             if segment is None:
                 continue  # no live copy to replicate from
             # add new replicas first (keeps availability)
             for s in sorted(desired - current):
-                self.servers[s].add_segment(table, segment)
+                servers[s].add_segment(table, segment)
                 added += 1
-            survivors = {s for s in desired if s in self.live}
+            survivors = {s for s in desired if s in live}
             for s in sorted(current - desired):
-                if len(survivors) >= min_available_replicas and s in self.servers:
-                    self.servers[s].drop_segment(table, seg_name)
+                if len(survivors) >= min_available_replicas and s in servers:
+                    servers[s].drop_segment(table, seg_name)
                     dropped += 1
                 else:
                     desired.add(s)  # keep the old copy: availability floor
@@ -225,9 +244,12 @@ class Coordinator:
         return self._assign(meta, seg_name)
 
     def _find_segment_object(self, table: str, seg_name: str, candidates) -> Optional[ImmutableSegment]:
+        with self._membership_lock:
+            live = set(self.live)
+            servers = dict(self.servers)
         for s in candidates:
-            if s in self.live and s in self.servers:
-                seg = self.servers[s].get_segment(table, seg_name)
+            if s in live and s in servers:
+                seg = servers[s].get_segment(table, seg_name)
                 if seg is not None:
                     return seg
         return None
@@ -237,6 +259,8 @@ class Coordinator:
         """RetentionManager: drop segments whose time range fell out of the
         retention window."""
         now_ms = now_ms or int(time.time() * 1000)
+        with self._membership_lock:
+            servers = dict(self.servers)
         purged: List[str] = []
         unit_ms = {"DAYS": 86_400_000, "HOURS": 3_600_000, "MINUTES": 60_000}
         for table, meta in self.tables.items():
@@ -248,8 +272,8 @@ class Coordinator:
                 tr = meta.segment_meta.get(seg_name, {}).get("timeRange")
                 if tr is not None and tr[1] is not None and tr[1] < horizon:
                     for s in meta.ideal.pop(seg_name):
-                        if s in self.servers:
-                            self.servers[s].drop_segment(table, seg_name)
+                        if s in servers:
+                            servers[s].drop_segment(table, seg_name)
                     meta.segment_meta.pop(seg_name, None)
                     purged.append(f"{table}/{seg_name}")
         return purged
@@ -264,14 +288,18 @@ class Coordinator:
             self._heartbeats: Dict[str, float] = {}
         self._heartbeats[server_name] = time.monotonic()
         # a recovered server resumes serving (Helix session re-establishment)
-        if server_name in self.servers and server_name not in self.live:
+        with self._membership_lock:
+            recovered = server_name in self.servers and server_name not in self.live
+        if recovered:
             self.mark_up(server_name)
 
     def check_liveness(self, timeout_s: float = 30.0) -> List[str]:
         """Mark servers with stale heartbeats down; returns who was dropped."""
         now = time.monotonic()
         dropped = []
-        for name in list(self.live):
+        with self._membership_lock:
+            live = list(self.live)
+        for name in live:
             hb = getattr(self, "_heartbeats", {}).get(name)
             if hb is not None and now - hb > timeout_s:
                 self.mark_down(name)
@@ -288,8 +316,10 @@ class Coordinator:
         consumed = self.run_realtime_consumption(max_batches=4)
         status = self.status_report()
         rebalanced = []
+        with self._membership_lock:
+            any_live = bool(self.live)
         for table, st in status.items():
-            if st["underReplicated"] and self.live:
+            if st["underReplicated"] and any_live:
                 self.rebalance(table)
                 rebalanced.append(table)
         return {
@@ -324,16 +354,18 @@ class Coordinator:
 
     def status_report(self) -> Dict[str, Dict]:
         """SegmentStatusChecker: per-table replica health."""
+        with self._membership_lock:
+            live = set(self.live)
         out: Dict[str, Dict] = {}
         for table, meta in self.tables.items():
             under = []
             for seg, servers in meta.ideal.items():
-                live = sum(1 for s in servers if s in self.live)
-                if live < min(self.replication, len(servers)) or live == 0:
+                n_live = sum(1 for s in servers if s in live)
+                if n_live < min(self.replication, len(servers)) or n_live == 0:
                     under.append(seg)
             out[table] = {
                 "segments": len(meta.ideal),
                 "underReplicated": under,
-                "liveServers": sorted(self.live),
+                "liveServers": sorted(live),
             }
         return out

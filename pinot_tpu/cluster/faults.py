@@ -133,10 +133,13 @@ class FaultPlan:
 
     def segment_dropped(self, server: str, table: str, segment: str) -> bool:
         if (server, table, segment) in self._dropped:
-            self.log.append((server, self._calls.get(server, 0), "drop_segment", segment))
+            with self._lock:
+                n = self._calls.get(server, 0)
+            self.log.append((server, n, "drop_segment", segment))
             return True
         return False
 
     def calls(self, server: str) -> int:
         """How many execute calls the server has received under this plan."""
-        return self._calls.get(server, 0)
+        with self._lock:
+            return self._calls.get(server, 0)

@@ -208,7 +208,8 @@ class RealtimeTableDataManager:
         cp = self._checkpoint.setdefault(str(partition), {"offset": 0, "seq": 0, "segments": []})
         cp["offset"] = offset
         cp["seq"] = seq
-        cp["segments"] = [s.name for s in self.sealed[partition]]
+        with self._lock:
+            cp["segments"] = [s.name for s in self.sealed[partition]]
         tmp = self._checkpoint_path() + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(self._checkpoint, f)
@@ -271,7 +272,8 @@ class RealtimeTableDataManager:
         the segment list the broker's routing table would return."""
         out: List[ImmutableSegment] = []
         for p in range(self.num_partitions):
-            out.extend(self.sealed[p])
+            with self._lock:
+                out.extend(self.sealed[p])
             mgr = self.managers.get(p)
             if mgr is not None and mgr.mutable.num_docs > 0:
                 snap = mgr.mutable.snapshot()
@@ -282,6 +284,6 @@ class RealtimeTableDataManager:
 
     @property
     def total_rows(self) -> int:
-        return sum(s.num_docs for segs in self.sealed.values() for s in segs) + sum(
-            m.mutable.num_docs for m in self.managers.values()
-        )
+        with self._lock:
+            sealed_rows = sum(s.num_docs for segs in self.sealed.values() for s in segs)
+        return sealed_rows + sum(m.mutable.num_docs for m in self.managers.values())

@@ -140,7 +140,8 @@ class MutableSegment:
 
     @property
     def num_docs(self) -> int:
-        return self._num_docs
+        with self._lock:
+            return self._num_docs
 
     def value_at(self, column: str, doc_id: int) -> Any:
         """Point read of one ingested value (upsert comparison reads)."""

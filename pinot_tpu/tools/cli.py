@@ -158,23 +158,49 @@ def cmd_slow_queries(args) -> int:
 
 
 def cmd_lint(args) -> int:
-    """JAX-aware static lint (analysis/repo_lint.py) over the package tree
-    or explicit paths; exit 1 when findings exist so CI can gate on it."""
-    from pinot_tpu.analysis.repo_lint import RULES, lint_paths, lint_tree
+    """Static lint: per-file rules (analysis/repo_lint.py) plus the
+    interprocedural passes (analysis/engine.py — race detector + sync
+    auditor with baseline.json) over the package tree; explicit paths run
+    the per-file rules only.  Exit 1 when findings exist so CI gates on it."""
+    from pinot_tpu.analysis.repo_lint import RULES, lint_paths
 
+    stale = []
+    baselined = 0
     if args.paths:
         findings = lint_paths(args.paths)
     else:
-        findings = lint_tree()
+        from pinot_tpu.analysis.engine import run_project
+
+        report = run_project()
+        findings = report.findings
+        stale = report.stale_baseline
+        baselined = report.baselined
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "count": len(findings),
+                    "baselined": baselined,
+                    "staleBaseline": stale,
+                    "rules": {r: RULES[r] for r in sorted({f.rule for f in findings})},
+                },
+                indent=2,
+            )
+        )
+        return 1 if findings or stale else 0
     for f in findings:
         print(f)
+    for e in stale:
+        print(f"stale baseline entry (fixed? delete it): {json.dumps(e)}")
     if findings and args.explain:
         print("\nrules:", file=sys.stderr)
         hit = {f.rule for f in findings}
         for rule in sorted(hit):
             print(f"  {rule}: {RULES.get(rule, '?')}", file=sys.stderr)
-    print(f"{len(findings)} finding(s)", file=sys.stderr)
-    return 1 if findings else 0
+    suffix = f" ({baselined} baselined)" if baselined else ""
+    print(f"{len(findings)} finding(s){suffix}", file=sys.stderr)
+    return 1 if findings or stale else 0
 
 
 def main(argv=None) -> int:
@@ -212,6 +238,7 @@ def main(argv=None) -> int:
     lt = sub.add_parser("lint", help="JAX-aware static lint over the pinot_tpu tree")
     lt.add_argument("paths", nargs="*", help="python files to lint (default: the installed package)")
     lt.add_argument("--explain", action="store_true", help="print rule descriptions for findings")
+    lt.add_argument("--json", action="store_true", help="machine-readable findings report")
     lt.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)

@@ -78,7 +78,8 @@ class Timer:
 
     @property
     def mean_ms(self) -> float:
-        return self.total_ms / self.count if self.count else 0.0
+        with self._lock:
+            return self.total_ms / self.count if self.count else 0.0
 
     def _snap(self) -> Dict[str, Any]:
         with self._lock:

@@ -1,0 +1,217 @@
+"""Host-device sync auditor (pinot_tpu.analysis.device_sync).
+
+Fixture packages route taint from jnp.* sources into the W013/W014
+sinks on a synthetic warm path; clean counterparts sanitize via
+jax.device_get or stay off the warm path and must report nothing."""
+import textwrap
+
+from pinot_tpu.analysis.device_sync import DeviceSyncPass
+from pinot_tpu.analysis.engine import Project, run_passes
+
+
+def _findings(src, warm=("warm.py",), allowed=None, **extra):
+    files = {"pkg/warm.py": textwrap.dedent(src)}
+    for name, body in extra.items():
+        files[f"pkg/{name}.py"] = textwrap.dedent(body)
+    proj = Project.from_sources(files)
+    pass_ = DeviceSyncPass(
+        warm_suffixes=warm,
+        allowed_syncs=allowed if allowed is not None else set(),
+    )
+    return run_passes(proj, [pass_])
+
+
+def _rules(src, **kw):
+    return [f.rule for f in _findings(src, **kw)]
+
+
+class TestW013ImplicitSync:
+    def test_flags_float_on_device_value(self):
+        src = """
+        import jax.numpy as jnp
+
+        def scale(x):
+            y = jnp.sum(x)
+            return float(y)
+        """
+        found = _findings(src)
+        assert [f.rule for f in found] == ["W013"]
+        assert found[0].symbol == "scale"
+        assert "float()" in found[0].message and found[0].hint
+
+    def test_flags_item_and_np_asarray_on_device_values(self):
+        src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def pull(x):
+            t = jnp.max(x)
+            host = np.asarray(t)
+            return host
+
+        def one(x):
+            return jnp.argmax(x).item()
+        """
+        assert _rules(src) == ["W013", "W013"]
+
+    def test_flags_block_until_ready_unconditionally(self):
+        src = """
+        import jax
+
+        def fence(x):
+            jax.block_until_ready(x)
+            return x
+        """
+        found = _findings(src)
+        assert [f.rule for f in found] == ["W013"]
+        assert "block_until_ready" in found[0].message
+
+    def test_allowlist_admits_the_sanctioned_fence(self):
+        src = """
+        import jax
+
+        class Server:
+            def execute(self, pending):
+                jax.block_until_ready(pending)
+                return pending
+        """
+        assert _rules(src, allowed={("warm.py", "Server.execute")}) == []
+        # same code, no allowlist entry: flagged
+        assert _rules(src) == ["W013"]
+
+    def test_taint_flows_through_project_function_returns(self):
+        src = """
+        import jax.numpy as jnp
+
+        def produce(x):
+            return jnp.cumsum(x)
+
+        def consume(x):
+            r = produce(x)
+            return int(r)
+        """
+        found = _findings(src)
+        assert [f.rule for f in found] == ["W013"]
+        assert found[0].symbol == "consume"
+
+    def test_taint_flows_through_cross_module_returns(self):
+        src = """
+        from pkg.kernels import fused_sum
+
+        def drain(x):
+            r = fused_sum(x)
+            return float(r)
+        """
+        kernels = """
+        import jax.numpy as jnp
+
+        def fused_sum(x):
+            return jnp.sum(x)
+        """
+        assert _rules(src, kernels=kernels) == ["W013"]
+
+    def test_quiet_after_device_get_sanitizer(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        def ok(x):
+            y = jnp.sum(x)
+            host = jax.device_get(y)
+            return float(host)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_metadata_attributes(self):
+        src = """
+        import jax.numpy as jnp
+
+        def rows(x):
+            y = jnp.add(x, 1)
+            return int(y.shape[0]) + int(y.ndim)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_off_the_warm_path(self):
+        src = """
+        import jax.numpy as jnp
+
+        def scale(x):
+            return float(jnp.sum(x))
+        """
+        proj = Project.from_sources({"pkg/coldpath.py": textwrap.dedent(src)})
+        out = run_passes(proj, [DeviceSyncPass(warm_suffixes=("warm.py",), allowed_syncs=set())])
+        assert out == []
+
+
+class TestW014HostBranchOnDeviceValue:
+    def test_flags_if_on_device_value(self):
+        src = """
+        import jax.numpy as jnp
+
+        def route(x):
+            v = jnp.mean(x)
+            if v > 0:
+                return 1
+            return 0
+        """
+        found = _findings(src)
+        assert [f.rule for f in found] == ["W014"]
+        assert found[0].symbol == "route"
+        assert "jnp.where" in found[0].hint or "plan time" in found[0].hint
+
+    def test_flags_while_on_device_value(self):
+        src = """
+        import jax.numpy as jnp
+
+        def spin(x):
+            err = jnp.max(x)
+            while err > 1e-6:
+                err = err * 0.5
+            return err
+        """
+        assert _rules(src) == ["W014"]
+
+    def test_quiet_when_branching_on_host_copy_or_none_check(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        def route(x):
+            v = jnp.mean(x)
+            if x is None:
+                return 0
+            host = jax.device_get(v)
+            if host > 0:
+                return 1
+            return 0
+        """
+        assert _rules(src) == []
+
+    def test_traced_bodies_are_excluded(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            y = jnp.sum(x)
+            if y > 0:
+                return y
+            return -y
+        """
+        assert _rules(src) == []
+
+    def test_function_passed_to_trace_wrapper_is_excluded(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        def body(x):
+            y = jnp.sum(x)
+            return bool(y)
+
+        def launch(x):
+            return jax.lax.cond(True, body, body, x)
+        """
+        assert _rules(src) == []

@@ -1,0 +1,223 @@
+"""Interprocedural engine (pinot_tpu.analysis.engine + callgraph):
+project model from in-memory sources, call resolution, reachability,
+inline suppressions across old and new rules, and baseline matching."""
+import textwrap
+
+from pinot_tpu.analysis.callgraph import CallGraph
+from pinot_tpu.analysis.engine import (
+    Project,
+    apply_baseline,
+    run_passes,
+)
+from pinot_tpu.analysis.races import RacePass
+from pinot_tpu.analysis.repo_lint import Finding, lint_source
+
+
+def _project(**files):
+    return Project.from_sources(
+        {f"pkg/{name.replace('__', '/')}.py": textwrap.dedent(src) for name, src in files.items()}
+    )
+
+
+class TestProjectModel:
+    def test_indexes_modules_functions_and_methods(self):
+        proj = _project(
+            a__b="""
+            def top():
+                pass
+
+            class C:
+                def m(self):
+                    pass
+            """
+        )
+        # "a__b" -> relpath pkg/a/b.py -> module pkg.a.b
+        assert "pkg.a.b" in proj.modules
+        assert "pkg.a.b.top" in proj.functions
+        assert "pkg.a.b.C" in proj.classes
+        assert "pkg.a.b.C.m" in proj.functions
+        assert proj.functions["pkg.a.b.C.m"].cls is proj.classes["pkg.a.b.C"]
+
+    def test_dunder_init_maps_to_package_name(self):
+        proj = Project.from_sources({"pkg/sub/__init__.py": "def boot():\n    pass\n"})
+        assert "pkg.sub" in proj.modules
+        assert "pkg.sub.boot" in proj.functions
+
+    def test_syntax_error_module_is_skipped_not_fatal(self):
+        proj = Project.from_sources({"pkg/bad.py": "def broken(:\n", "pkg/ok.py": "x = 1\n"})
+        assert "pkg.ok" in proj.modules and "pkg.bad" not in proj.modules
+
+    def test_threading_import_marks_module_threaded(self):
+        proj = _project(
+            hot="import threading\n",
+            cold="import json\n",
+            aliased="from threading import Lock\n",
+        )
+        assert proj.modules["pkg.hot"].threaded
+        assert proj.modules["pkg.aliased"].threaded
+        assert not proj.modules["pkg.cold"].threaded
+
+
+class TestResolution:
+    def test_resolves_self_method_local_function_and_external(self):
+        proj = _project(
+            m="""
+            import time
+            from pkg.util import helper
+
+            def local():
+                pass
+
+            class C:
+                def a(self):
+                    self.b()
+                    local()
+                    helper()
+                    time.sleep(1)
+
+                def b(self):
+                    pass
+            """,
+            util="""
+            def helper():
+                pass
+            """,
+        )
+        import ast
+
+        fi = proj.functions["pkg.m.C.a"]
+        calls = [n for n in ast.walk(fi.node) if isinstance(n, ast.Call)]
+        targets = {proj.resolve_call(fi, c) for c in calls}
+        assert targets == {"pkg.m.C.b", "pkg.m.local", "pkg.util.helper", "time.sleep"}
+
+    def test_resolves_inherited_method_through_base(self):
+        proj = _project(
+            m="""
+            class Base:
+                def shared(self):
+                    pass
+
+            class Child(Base):
+                def go(self):
+                    self.shared()
+            """
+        )
+        import ast
+
+        fi = proj.functions["pkg.m.Child.go"]
+        call = next(n for n in ast.walk(fi.node) if isinstance(n, ast.Call))
+        assert proj.resolve_call(fi, call) == "pkg.m.Base.shared"
+
+
+class TestCallGraph:
+    def test_edges_external_and_reachability(self):
+        proj = _project(
+            m="""
+            import time
+
+            def entry():
+                middle()
+
+            def middle():
+                time.sleep(1)
+
+            def orphan():
+                pass
+            """
+        )
+        g = CallGraph.build(proj)
+        assert "pkg.m.middle" in g.callees("pkg.m.entry")
+        assert "time.sleep" in g.external.get("pkg.m.middle", {})
+        reach = g.reachable_from(["pkg.m.entry"])
+        assert "pkg.m.middle" in reach and "pkg.m.orphan" not in reach
+
+    def test_instantiation_reaches_init(self):
+        proj = _project(
+            m="""
+            class C:
+                def __init__(self):
+                    pass
+
+            def make():
+                return C()
+            """
+        )
+        g = CallGraph.build(proj)
+        assert "pkg.m.C.__init__" in g.callees("pkg.m.make")
+
+
+class TestInlineSuppression:
+    def test_per_file_rule_honors_disable_comment(self):
+        src = textwrap.dedent(
+            """
+            class Broker:
+                def route(self):
+                    self._rr += 1  # pinot-lint: disable=W004
+            """
+        )
+        assert lint_source(src, path="cluster/b.py", threaded=True) == []
+
+    def test_disable_all_and_wrong_rule_spec(self):
+        base = "class B:\n    def r(self):\n        self._rr += 1{}\n"
+        assert lint_source(base.format("  # pinot-lint: disable=all"), "c/b.py", threaded=True) == []
+        kept = lint_source(base.format("  # pinot-lint: disable=W001"), "c/b.py", threaded=True)
+        assert [f.rule for f in kept] == ["W004"]
+
+    def test_interprocedural_rule_honors_disable_comment(self):
+        src = """
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._total = 0
+
+            def add(self, n):
+                with self._lock:
+                    self._total += n
+
+            def peek(self):
+                return self._total  # pinot-lint: disable=W010
+        """
+        flagged = run_passes(
+            _project(m=src.replace("  # pinot-lint: disable=W010", "")), [RacePass()]
+        )
+        assert [f.rule for f in flagged] == ["W010"]
+        assert run_passes(_project(m=src), [RacePass()]) == []
+
+
+class TestBaseline:
+    def test_matches_by_symbol_and_reports_stale(self):
+        findings = [
+            Finding("pinot_tpu/x.py", 10, "W010", "a", symbol="C.m"),
+            Finding("pinot_tpu/y.py", 20, "W013", "b", symbol="f"),
+        ]
+        baseline = [
+            {"rule": "W010", "path": "pinot_tpu/x.py", "symbol": "C.m", "justification": "ok"},
+            {"rule": "W012", "path": "pinot_tpu/gone.py", "symbol": "D.n", "justification": "old"},
+        ]
+        kept, baselined, stale = apply_baseline(findings, baseline)
+        assert [f.rule for f in kept] == ["W013"]
+        assert baselined == 1
+        assert stale == [baseline[1]]
+
+    def test_symbol_mismatch_does_not_match_even_on_same_line(self):
+        findings = [Finding("pinot_tpu/x.py", 10, "W010", "a", symbol="C.m")]
+        baseline = [{"rule": "W010", "path": "pinot_tpu/x.py", "symbol": "C.other"}]
+        kept, baselined, stale = apply_baseline(findings, baseline)
+        assert len(kept) == 1 and baselined == 0 and len(stale) == 1
+
+    def test_line_fallback_when_no_symbol(self):
+        findings = [Finding("pinot_tpu/x.py", 10, "W010", "a", symbol="C.m")]
+        baseline = [{"rule": "W010", "path": "pinot_tpu/x.py", "line": 10}]
+        kept, baselined, _stale = apply_baseline(findings, baseline)
+        assert kept == [] and baselined == 1
+
+
+def test_finding_to_dict_and_hint_rendering():
+    f = Finding("a/b.py", 12, "W010", "msg", hint="take the lock", symbol="C.m")
+    assert str(f) == "a/b.py:12: W010 msg [fix: take the lock]"
+    d = f.to_dict()
+    assert d["path"] == "a/b.py" and d["rule"] == "W010" and d["symbol"] == "C.m"
+    # no-hint findings keep the legacy greppable format
+    assert str(Finding("a/b.py", 12, "W001", "msg")) == "a/b.py:12: W001 msg"

@@ -1,0 +1,314 @@
+"""Lock-discipline race detector (pinot_tpu.analysis.races).
+
+Each rule fires on a minimal seeded-bug fixture package and stays quiet
+on the properly-locked counterpart, mirroring the W004-W006 fixture
+style: true positive + clean negative per rule."""
+import textwrap
+
+from pinot_tpu.analysis.engine import Project, run_passes
+from pinot_tpu.analysis.races import RacePass
+
+
+def _findings(src, check_all_classes=False, **extra):
+    files = {"pkg/m.py": textwrap.dedent(src)}
+    for name, body in extra.items():
+        files[f"pkg/{name}.py"] = textwrap.dedent(body)
+    proj = Project.from_sources(files)
+    return run_passes(proj, [RacePass(check_all_classes=check_all_classes)])
+
+
+def _rules(src, **kw):
+    return [f.rule for f in _findings(src, **kw)]
+
+
+class TestW010GuardedAttrAccess:
+    def test_flags_read_outside_the_guarding_lock(self):
+        src = """
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._total = 0
+
+            def add(self, n):
+                with self._lock:
+                    self._total += n
+
+            def snapshot(self):
+                return self._total
+        """
+        found = _findings(src)
+        assert [f.rule for f in found] == ["W010"]
+        assert found[0].symbol == "Stats.snapshot"
+        assert "_total" in found[0].message and "_lock" in found[0].message
+        assert found[0].hint  # fix hint travels with the finding
+
+    def test_flags_unlocked_write(self):
+        src = """
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, v):
+                with self._lock:
+                    self._items.append(v)
+
+            def reset(self):
+                self._items = []
+        """
+        found = _findings(src)
+        assert [f.rule for f in found] == ["W010"]
+        assert found[0].symbol == "Stats.reset"
+
+    def test_quiet_when_every_access_holds_the_lock(self):
+        src = """
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._total = 0
+
+            def add(self, n):
+                with self._lock:
+                    self._total += n
+
+            def snapshot(self):
+                with self._lock:
+                    return self._total
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_init_and_init_only_helpers(self):
+        src = """
+        import threading
+
+        class Manager:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._segs = []
+                self._recover()
+
+            def _recover(self):
+                self._segs = ["recovered"]
+
+            def add(self, s):
+                with self._lock:
+                    self._segs.append(s)
+        """
+        assert _rules(src) == []
+
+    def test_locked_helper_convention_counts_as_holding_the_lock(self):
+        src = """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._data[k] = v
+                    self._evict_locked()
+
+            def _evict_locked(self):
+                self._data.pop(None, None)
+        """
+        assert _rules(src) == []
+
+    def test_threaded_reachability_restriction(self):
+        # no threading import anywhere: default scope skips the class,
+        # check_all_classes=True (the fixture escape hatch) still checks it
+        src = """
+        class Quiet:
+            def add(self, n):
+                with self._lock:
+                    self._total = self._total + n
+
+            def read(self):
+                return self._total
+        """
+        assert _rules(src) == []
+        assert _rules(src, check_all_classes=True) == ["W010"]
+
+
+class TestW011LockOrderCycles:
+    def test_flags_abba_cycle_across_classes(self):
+        src = """
+        import threading
+
+        class First:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def alpha(self, other):
+                with self._lock:
+                    Second.beta_only(other)
+
+            def alpha_only(self):
+                with self._lock:
+                    pass
+
+        class Second:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def beta(self, other):
+                with self._lock:
+                    First.alpha_only(other)
+
+            def beta_only(self):
+                with self._lock:
+                    pass
+        """
+        found = _findings(src)
+        assert [f.rule for f in found] == ["W011"]
+        assert "lock-order cycle" in found[0].message
+
+    def test_flags_non_reentrant_self_deadlock_through_call_chain(self):
+        src = """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+                    self.flush()
+
+            def flush(self):
+                with self._lock:
+                    self._items.clear()
+        """
+        found = [f for f in _findings(src) if f.rule == "W011"]
+        assert len(found) == 1
+        assert "self-deadlock" in found[0].message
+        assert found[0].symbol == "Cache.put"
+
+    def test_quiet_on_rlock_reacquisition(self):
+        # same shape as the self-deadlock case but the lock is reentrant
+        src = """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+                    self.flush()
+
+            def flush(self):
+                with self._lock:
+                    self._items.clear()
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_consistent_one_way_ordering(self):
+        src = """
+        import threading
+
+        class First:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def alpha(self, other):
+                with self._lock:
+                    Second.beta_only(other)
+
+        class Second:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def beta_only(self):
+                with self._lock:
+                    pass
+        """
+        assert _rules(src) == []
+
+
+class TestW012BlockingUnderLock:
+    def test_flags_direct_sleep_in_locked_region(self):
+        src = """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def wait_turn(self):
+                with self._lock:
+                    self._n += 1
+                    time.sleep(0.1)
+        """
+        found = [f for f in _findings(src) if f.rule == "W012"]
+        assert len(found) == 1
+        assert "time.sleep" in found[0].message and found[0].symbol == "Poller.wait_turn"
+
+    def test_flags_blocking_call_reached_through_helper(self):
+        src = """
+        import threading
+        import time
+
+        def backoff():
+            time.sleep(1.0)
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def wait_turn(self):
+                with self._lock:
+                    self._n += 1
+                    backoff()
+        """
+        found = [f for f in _findings(src) if f.rule == "W012"]
+        assert len(found) == 1
+        assert "backoff" in found[0].message and "time.sleep" in found[0].message
+
+    def test_flags_device_sync_method_under_lock(self):
+        src = """
+        import threading
+
+        class Holder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._out = None
+
+            def publish(self, fut):
+                with self._lock:
+                    self._out = fut.block_until_ready()
+        """
+        found = [f for f in _findings(src) if f.rule == "W012"]
+        assert len(found) == 1
+        assert "block_until_ready" in found[0].message
+
+    def test_quiet_when_blocking_call_is_hoisted_out(self):
+        src = """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def wait_turn(self):
+                with self._lock:
+                    self._n += 1
+                time.sleep(0.1)
+        """
+        assert _rules(src) == []

@@ -1,0 +1,66 @@
+"""Threaded stress for utils.cache.LruCache: concurrent get/put/invalidate
+must never raise, never exceed the configured bounds, and keep the byte
+accounting consistent with the surviving entries — the invariants the
+W010 race class would break."""
+import threading
+
+from pinot_tpu.utils.cache import LruCache
+
+
+def _hammer(cache, n_threads, n_ops, keyspace, value_of):
+    errors = []
+    start = threading.Barrier(n_threads)
+
+    def worker(seed):
+        try:
+            start.wait(timeout=10)
+            for i in range(n_ops):
+                k = (seed * 31 + i * 7) % keyspace
+                op = (seed + i) % 4
+                if op == 0:
+                    cache.put(k, value_of(k))
+                elif op == 1:
+                    v = cache.get(k)
+                    assert v is None or v == value_of(k)
+                elif op == 2:
+                    cache.invalidate(k)
+                else:
+                    cache.put(k, value_of(k))
+                    len(cache)
+                    k in cache
+        except Exception as e:  # surfaced to the main thread below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "stress worker wedged (deadlock?)"
+    return errors
+
+
+def test_concurrent_get_put_respects_entry_bound():
+    from pinot_tpu.utils.metrics import METRICS
+
+    cache = LruCache(max_entries=32, name="stress.lru")
+    errors = _hammer(
+        cache, n_threads=8, n_ops=2000, keyspace=100, value_of=lambda k: [k] * 4
+    )
+    assert errors == []
+    assert len(cache) <= 32
+    assert cache.stats()["entries"] == len(cache)
+    counters = METRICS.snapshot()["counters"]
+    assert counters.get("stress.lru.evictions", 0) > 0, "stress must exercise eviction"
+    assert counters.get("stress.lru.hits", 0) + counters.get("stress.lru.misses", 0) > 0
+
+
+def test_concurrent_eviction_keeps_byte_accounting_consistent():
+    cache = LruCache(max_bytes=4096, sizeof=lambda v: 256)
+    errors = _hammer(
+        cache, n_threads=6, n_ops=1500, keyspace=64, value_of=lambda k: ("v", k)
+    )
+    assert errors == []
+    # quiesced: tracked bytes must equal the sum over surviving entries
+    assert cache.bytes == 256 * len(cache)
+    assert cache.bytes <= 4096

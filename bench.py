@@ -62,6 +62,130 @@ def _cpu_proxy(sample_rows: int = 1 << 23) -> float:
     return sample_rows / dt
 
 
+def _overload_bench() -> dict:
+    """Offered-load sweep through the broker's admission controller (round-11
+    overload governance): estimate single-stream capacity on a small broker
+    cluster, then offer 0.5x / 1x / 3x that rate with the token bucket
+    clocked by the *simulated* arrival times (deterministic: admission
+    depends only on the arrival schedule, not host speed).  Reports
+    admitted/shed/killed counts and the admitted-query p99 — the tracked
+    proof that 3x overload sheds with structured 429s instead of queueing
+    unboundedly or crashing."""
+    from pinot_tpu.cluster.admission import (
+        AdmissionController,
+        QueryKilledError,
+        ReservationError,
+        TooManyRequestsError,
+        estimate_query_cost,
+    )
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.coordinator import Coordinator
+    from pinot_tpu.cluster.server import ServerInstance
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.spi.config import SegmentsConfig, TableConfig
+    from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+    from pinot_tpu.sql.parser import parse_query
+
+    schema = Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+    coord = Coordinator(replication=2)
+    for i in range(2):
+        coord.register_server(ServerInstance(f"server{i}"))
+    coord.add_table(schema, TableConfig(name="t", segments=SegmentsConfig(time_column="ts")))
+    rng = np.random.default_rng(11)
+    rows = int(os.environ.get("BENCH_OVERLOAD_ROWS", 50_000))
+    for i in range(4):
+        coord.add_segment(
+            "t",
+            build_segment(
+                schema,
+                {
+                    "city": rng.choice(["sf", "nyc", "la"], rows).astype(object),
+                    "v": rng.integers(0, 100, rows),
+                    "ts": 1_700_000_000_000 + rng.integers(0, 86_400_000, rows).astype(np.int64),
+                },
+                f"seg{i}",
+            ),
+        )
+    broker = Broker(coord)
+
+    # distinct literal per query: misses the result cache every time (full
+    # scatter path) while the parameterized plan cache stays warm
+    def sql_at(i: int) -> str:
+        return (
+            "SELECT city, COUNT(*), SUM(v) FROM t "
+            f"WHERE v < {50 + i % 40} GROUP BY city ORDER BY city"
+        )
+
+    broker.query(sql_at(0))  # warm: parse, plan, compile
+
+    # ---- uncontended baseline (governor at env defaults: admission off) --
+    n_base = 40
+    base_ts = []
+    for i in range(n_base):
+        t0 = time.perf_counter()
+        broker.query(sql_at(i))
+        base_ts.append((time.perf_counter() - t0) * 1000)
+    uncontended_p99 = float(np.percentile(base_ts, 99))
+    capacity_qps = 1000.0 / float(np.median(base_ts))
+
+    ctx = parse_query(sql_at(0))
+    unit_cost = estimate_query_cost(ctx, coord.tables["t"].segment_meta.values()).units
+
+    sweep = []
+    for mult in (0.5, 1.0, 3.0):
+        # fresh bucket per load point, clocked by the simulated arrival
+        # schedule; max_queue=0 = admit-or-shed (the sim clock never
+        # advances inside a wait, so queueing would never drain)
+        sim = [0.0]
+        adm = AdmissionController(
+            rate_units_per_s=capacity_qps * unit_cost,
+            burst_units=2 * unit_cost,
+            max_queue=0,
+        )
+        adm.clock = lambda: sim[0]
+        broker.governor.admission = adm
+        offered_qps = mult * capacity_qps
+        admitted = shed = killed = 0
+        admitted_ms = []
+        for i in range(120):
+            sim[0] += 1.0 / offered_qps  # next arrival
+            t0 = time.perf_counter()
+            try:
+                broker.query(sql_at(i))
+            except TooManyRequestsError:
+                shed += 1
+            except (QueryKilledError, ReservationError):
+                killed += 1
+            else:
+                admitted += 1
+                admitted_ms.append((time.perf_counter() - t0) * 1000)
+        sweep.append(
+            {
+                "offered_x": mult,
+                "offered_qps": round(offered_qps, 1),
+                "admitted": admitted,
+                "shed": shed,
+                "killed": killed,
+                "admitted_p99_ms": (
+                    round(float(np.percentile(admitted_ms, 99)), 3) if admitted_ms else None
+                ),
+            }
+        )
+    broker.governor.admission = AdmissionController()  # back to permissive
+    return {
+        "uncontended_p99_ms": round(uncontended_p99, 3),
+        "capacity_qps_est": round(capacity_qps, 1),
+        "sweep": sweep,
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -304,6 +428,7 @@ def main() -> None:
                 "baseline_denominator": JAVA_SERVER_ROWS_PER_SEC,
                 "backend": ops.scan_backend(),
                 "effective_bytes_per_sec": round(rows_per_sec * bytes_per_row, 1),
+                "overload": _overload_bench(),
             }
         )
     )

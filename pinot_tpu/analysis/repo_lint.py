@@ -1,6 +1,6 @@
 """JAX-aware repo lint: ast pass over the pinot_tpu tree.
 
-Eight rules, each targeting an anti-pattern this codebase has actually
+Per-file rules, each targeting an anti-pattern this codebase has actually
 been bitten by (ADVICE r5) or that silently degrades TPU throughput:
 
   W001 float-literal-in-jit   bare float literal used in arithmetic or a
@@ -46,6 +46,19 @@ been bitten by (ADVICE r5) or that silently degrades TPU throughput:
                               `.shape_fingerprint()` (query/shape.py), which
                               canonicalizes literals into parameter slots.
                               Result caches and logs keep the full form.
+  W015 unbounded-growth       a container attribute created unbounded in
+                              `__init__` (list/set/dict/deque-without-maxlen)
+                              that a cluster/ *serving-path* method (execute,
+                              handle, scatter, admit, record, ...) appends to
+                              or keys by a per-request value (query id, sql,
+                              uuid), with no eviction anywhere in the class —
+                              every request leaks a little host memory until
+                              the server OOMs under sustained load.  Any
+                              eviction evidence (pop/clear/del/reassignment
+                              outside __init__) or a deque(maxlen=...) bound
+                              exempts the attribute; dict writes keyed by
+                              bounded label spaces (table/segment/server
+                              names) stay clean.
 
 Kernel bodies (W001/W002 scope) are functions the module jits: decorated
 with @jax.jit / @partial(jax.jit, ...) or passed by name to jax.jit(...)
@@ -76,6 +89,7 @@ RULES: Dict[str, str] = {
     "W006": "except block in cluster/ swallows the exception without recording it",
     "W007": "metric/span name interpolates an unbounded value (cardinality explosion)",
     "W008": "literal-baked fingerprint() used as a plan-cache key (use shape_fingerprint)",
+    "W015": "unbounded container growth on a cluster serving path (no bound/eviction)",
     # interprocedural passes (analysis/races.py, analysis/device_sync.py —
     # run via analysis/engine.py over the whole package, not per-file):
     "W010": "lock-guarded attribute read/written without holding its lock",
@@ -628,6 +642,167 @@ def _check_w008(path: str, tree: ast.AST, findings: List[Finding]) -> None:
             scan_scope(node.body)
 
 
+_W015_GROW = frozenset({"append", "extend", "appendleft", "add", "insert"})
+_W015_EVICT = frozenset({"pop", "popitem", "popleft", "clear", "discard", "remove"})
+_W015_DICTLIKE = frozenset({"dict", "OrderedDict", "defaultdict", "Counter"})
+_W015_SEQLIKE = frozenset({"list", "set", "deque"})
+# method-name fragments marking the request-serving path — growth in setup /
+# registration / teardown methods is a topology-sized one-shot, not a leak
+_W015_SERVING = (
+    "execute", "query", "handle", "scatter", "admit",
+    "record", "check", "serve", "request", "do_",
+)
+
+
+def _check_w015(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """Unbounded container growth on a serving path: an attribute born
+    unbounded in `__init__` (list/set/dict literal, `deque()` with no
+    maxlen) that a serving-named method grows per request — `.append()`
+    and friends, or a dict write keyed by an unbounded value (query id,
+    sql, uuid; W007's hint list) — while NOTHING in the class ever evicts.
+    Eviction evidence is any `.pop/.clear/.discard/...` call on the
+    attribute, a `del self.x[...]`, or a reassignment outside `__init__`.
+    Dict writes keyed by bounded label spaces (table/segment/server names)
+    never flag: only per-request key spaces grow without bound."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        # --- pass 1: containers created unbounded in __init__ ------------
+        unbounded: Dict[str, str] = {}  # attr -> "dict" | "seq"
+        init = next(
+            (n for n in cls.body if isinstance(n, ast.FunctionDef) and n.name == "__init__"),
+            None,
+        )
+        if init is None:
+            continue
+        for n in ast.walk(init):
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(n, ast.Assign):
+                targets, value = n.targets, n.value
+            elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                targets, value = [n.target], n.value
+            if value is None:
+                continue
+            kind: Optional[str] = None
+            if isinstance(value, ast.Dict):
+                kind = "dict"
+            elif isinstance(value, (ast.List, ast.Set)):
+                kind = "seq"
+            elif isinstance(value, ast.Call):
+                fn = value.func
+                fname = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None
+                )
+                if fname in _W015_DICTLIKE:
+                    kind = "dict"
+                elif fname in _W015_SEQLIKE:
+                    if fname == "deque" and any(k.arg == "maxlen" for k in value.keywords):
+                        kind = None  # bounded ring buffer
+                    else:
+                        kind = "seq"
+            if kind is None:
+                continue
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    unbounded[attr] = kind
+        if not unbounded:
+            continue
+        # --- pass 2: eviction evidence anywhere in the class exempts -----
+        for n in ast.walk(cls):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _W015_EVICT
+            ):
+                attr = _self_attr(n.func.value)
+                if attr is not None:
+                    unbounded.pop(attr, None)
+            elif isinstance(n, ast.Delete):
+                for t in n.targets:
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    attr = _self_attr(base)
+                    if attr is not None:
+                        unbounded.pop(attr, None)
+        for meth in cls.body:
+            if not isinstance(meth, ast.FunctionDef) or meth.name == "__init__":
+                continue
+            for n in ast.walk(meth):
+                targets = (
+                    n.targets if isinstance(n, ast.Assign)
+                    else [n.target] if isinstance(n, (ast.AnnAssign, ast.AugAssign))
+                    else []
+                )
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        unbounded.pop(attr, None)  # rebuilt/reset elsewhere
+        if not unbounded:
+            continue
+        # --- pass 3: growth inside serving-named methods -----------------
+        for meth in cls.body:
+            if not isinstance(meth, ast.FunctionDef):
+                continue
+            low = meth.name.lower()
+            if not any(h in low for h in _W015_SERVING):
+                continue
+            for n in ast.walk(meth):
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _W015_GROW
+                ):
+                    attr = _self_attr(n.func.value)
+                    if attr in unbounded and unbounded[attr] != "dict":
+                        findings.append(
+                            Finding(
+                                path, n.lineno, "W015",
+                                f"self.{attr}.{n.func.attr}(...) in serving method "
+                                f"{meth.name!r} grows without bound — no eviction "
+                                f"anywhere in class {cls.name!r}",
+                            )
+                        )
+                # dict growth: subscript-store or setdefault keyed by an
+                # unbounded (per-request) value
+                key: Optional[ast.expr] = None
+                attr = None
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Subscript):
+                            a = _self_attr(t.value)
+                            if a in unbounded and unbounded[a] == "dict":
+                                key, attr = t.slice, a
+                elif (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "setdefault"
+                    and n.args
+                ):
+                    a = _self_attr(n.func.value)
+                    if a in unbounded and unbounded[a] == "dict":
+                        key, attr = n.args[0], a
+                if key is None:
+                    continue
+                keyed_unbounded = False
+                for kn in ast.walk(key):
+                    name = kn.id if isinstance(kn, ast.Name) else (
+                        kn.attr if isinstance(kn, ast.Attribute) else None
+                    )
+                    if name is not None and _unbounded_hint(name):
+                        keyed_unbounded = True
+                        break
+                if keyed_unbounded:
+                    findings.append(
+                        Finding(
+                            path, n.lineno, "W015",
+                            f"self.{attr}[...] keyed by a per-request value in "
+                            f"serving method {meth.name!r} grows without bound — "
+                            f"no eviction anywhere in class {cls.name!r}",
+                        )
+                    )
+
+
 _SUPPRESS_MARK = "pinot-lint:"
 
 
@@ -664,7 +839,8 @@ def is_suppressed(f: Finding, suppressions: Dict[int, Optional[Set[str]]]) -> bo
 
 def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> List[Finding]:
     """Lint one module's source.  `threaded` enables the cluster/-scoped
-    rules (W004 shared-state races, W006 swallowed exceptions)."""
+    rules (W004 shared-state races, W006 swallowed exceptions, W015
+    unbounded serving-path growth)."""
     findings: List[Finding] = []
     try:
         tree = ast.parse(src)
@@ -690,6 +866,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
     if threaded:
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)
+        _check_w015(path, tree, findings)
     suppressions = parse_suppressions(src)
     if suppressions:
         findings = [f for f in findings if not is_suppressed(f, suppressions)]

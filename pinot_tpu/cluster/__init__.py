@@ -18,6 +18,17 @@ State transitions are direct method calls instead of Helix messages; the
 CONTRACTS (replication, min-available-replicas rebalance, routing
 consistency) match the reference.
 """
+from pinot_tpu.cluster.admission import (
+    AdmissionController,
+    QueryCost,
+    QueryKilledError,
+    QueryWatchdog,
+    ReservationError,
+    ResourceBudget,
+    ResourceGovernor,
+    TooManyRequestsError,
+    estimate_query_cost,
+)
 from pinot_tpu.cluster.coordinator import Coordinator
 from pinot_tpu.cluster.server import ServerInstance
 from pinot_tpu.cluster.broker import (
@@ -37,4 +48,13 @@ __all__ = [
     "ServerFaultError",
     "NoReplicaAvailableError",
     "ScatterGatherError",
+    "AdmissionController",
+    "QueryCost",
+    "QueryKilledError",
+    "QueryWatchdog",
+    "ReservationError",
+    "ResourceBudget",
+    "ResourceGovernor",
+    "TooManyRequestsError",
+    "estimate_query_cost",
 ]

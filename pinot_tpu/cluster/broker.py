@@ -21,6 +21,12 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from pinot_tpu.cluster.admission import (
+    QueryKilledError,
+    ReservationError,
+    ResourceGovernor,
+    estimate_query_cost,
+)
 from pinot_tpu.cluster.coordinator import Coordinator
 from pinot_tpu.query import reduce as reduce_mod
 from pinot_tpu.query.ir import FilterNode, FilterOp, PredicateType, QueryContext
@@ -250,11 +256,23 @@ class Broker:
 
         from pinot_tpu.utils.cache import LruCache
 
+        # resource governor (cluster/admission.py): token-bucket admission,
+        # host-memory ledger, runaway watchdog, degradation controller.
+        # The result cache charges the SAME host ledger the governor reserves
+        # query working sets from, so cached bytes + in-flight queries can
+        # never jointly overcommit host memory (r11).
+        self.governor: Optional[ResourceGovernor] = ResourceGovernor()
         self.result_cache = LruCache(
             max_bytes=max(1, int(os.environ.get("PINOT_TPU_RESULT_CACHE_BYTES", str(64 << 20)))),
             ttl_s=float(os.environ.get("PINOT_TPU_RESULT_CACHE_TTL_S", "60")),
             name="broker.resultCache",
+            budget=self.governor.host_budget,
         )
+        # the SSE plan cache (servers compile through it) charges the same
+        # ledger — idempotent for the shared process budget
+        from pinot_tpu.query.planner import attach_plan_cache_budget
+
+        attach_plan_cache_budget(self.governor.host_budget)
         coordinator.on_live_change(self._on_live_change)
 
     @staticmethod
@@ -440,11 +458,50 @@ class Broker:
         qid = f"{self._broker_id}_{next(self._qid_seq)}"
         trace = Trace(bool(ctx.options.get("trace", False)), query_id=qid)
         METRICS.counter("broker.queries").inc()
+        # admission bracket: root client requests only (subquery/set-op
+        # recursion rides the parent's grant).  Sheds (429) and capacity
+        # rejections (503) raise HERE, after the qid mint, so every
+        # structured rejection carries the query id; the grant's host
+        # reservation + watchdog registration release in the finally on
+        # every exit path (success, timeout, kill, server fault).
+        grant = None
+        cancel = None
+        gov = self.governor
+        if gov is not None and not _charged:
+            cost = estimate_query_cost(ctx, self.coordinator.tables[table].segment_meta.values())
+            grant = gov.admit(qid, ctx, cost, deadline)
+            cancel = gov.cancel_probe(qid)
+        try:
+            return self._serve(ctx, table, qid, trace, deadline, t0, cancel)
+        finally:
+            if grant is not None:
+                grant.close()
+
+    def _serve(
+        self,
+        ctx: QueryContext,
+        table: str,
+        qid: str,
+        trace: Trace,
+        deadline: Deadline,
+        t0: float,
+        cancel=None,
+    ) -> ResultTable:
+        """One admitted query's serve path: execute() holds the admission
+        grant around this call; `cancel` is the watchdog's kill probe,
+        threaded through scatter into every server's between-kernel check."""
+        gov = self.governor
         # result cache lookup: key on the post-resolution fingerprint +
         # table version token, BEFORE plan-time option injection mutates
-        # ctx.  Traced queries bypass it (a cached result carries no spans).
+        # ctx.  Traced queries bypass it (a cached result carries no spans);
+        # under memory pressure (degradation level >= 1) the cache is
+        # bypassed entirely — stop retaining bytes, stop serving stale ones.
         ckey = None
-        if self._result_cache_enabled(ctx) and not ctx.options.get("trace", False):
+        if (
+            self._result_cache_enabled(ctx)
+            and not ctx.options.get("trace", False)
+            and (gov is None or gov.degrade.result_cache_enabled())
+        ):
             ckey = (table, ctx.fingerprint(), self._table_version(table))
             hit = self.result_cache.get(ckey)
             if hit is not None:
@@ -471,6 +528,8 @@ class Broker:
                     shapeFp=shape_digest(ctx.shape_fingerprint()),
                     resultCache="bypass" if ckey is None else "miss",
                 )
+                if gov is not None and gov.degrade.level > 0:
+                    bsp.annotate(pressure=gov.degrade.level)
         # hybrid tables (offline segments + a realtime manager under ONE
         # name): a TIME BOUNDARY splits the parts — offline answers
         # ts <= boundary, realtime answers ts > boundary (TimeBoundaryManager
@@ -501,10 +560,17 @@ class Broker:
             try:
                 with trace.span("scatter", segments=len(seg_names)):
                     results.extend(
-                        self._scatter(offline_ctx, table, seg_names, meta, deadline, stats, trace)
+                        self._scatter(
+                            offline_ctx, table, seg_names, meta, deadline, stats, trace,
+                            cancel=cancel, qid=qid,
+                        )
                     )
             finally:
                 METRICS.gauge("broker.inFlightScatters").add(-1)
+        if any(e.get("errorCode") == "QUERY_KILLED" for e in stats.exceptions):
+            # the kill already degraded this query to a partial result —
+            # further probes must not re-raise and destroy what survived
+            cancel = None
         # realtime tables: sealed + consuming segments served from the
         # coordinator-owned manager (the RealtimeTableDataManager view)
         rt = self.coordinator.realtime.get(table)
@@ -515,6 +581,14 @@ class Broker:
                 rt_docs = 0
                 for seg in rt.query_segments():
                     deadline.check(f"query on {table}")
+                    if cancel is not None:
+                        reason = cancel()
+                        if reason:
+                            raise QueryKilledError(
+                                f"query {qid} killed between realtime segments ({reason})",
+                                query_id=qid,
+                                reason=reason,
+                            )
                     stats.num_segments_queried += 1
                     stats.total_docs += seg.num_docs
                     if sse_executor.prune_segment(realtime_ctx, seg):
@@ -556,6 +630,8 @@ class Broker:
         deadline: Deadline,
         stats: ExecutionStats,
         trace: Optional[Trace] = None,
+        cancel=None,
+        qid: Optional[str] = None,
     ) -> List:
         """Deadline-budgeted scatter with replica failover (the
         QueryRouter.submitQuery + BaseSingleStageBrokerRequestHandler retry
@@ -573,7 +649,17 @@ class Broker:
         Tracing: each failover round gets a `round:N` span; each routed call
         a `server_execute` span (server, round, probe, error, breaker state)
         with the server's own finished subtree grafted beneath it — the
-        retry/breaker machinery is visible in ONE tree per query."""
+        retry/breaker machinery is visible in ONE tree per query.
+
+        Governance faults are NOT server faults: a ReservationError (server
+        at HBM capacity) fails the segments over to another replica without
+        punishing the adaptive stats or tripping the breaker — capacity
+        returns when queries drain, quarantine would amplify the overload;
+        when EVERY replica is out of capacity the query fails structured
+        503 SERVER_OUT_OF_CAPACITY.  A QueryKilledError (watchdog) punishes
+        the adaptive stats exactly once, leaves the breaker untouched, and
+        either degrades to a partial result (allowPartialResults) or
+        re-raises as a structured QUERY_KILLED failure."""
         if trace is None:
             trace = Trace(False)
         opts = ctx.options
@@ -587,6 +673,9 @@ class Broker:
         responded: Set[str] = set()
         pending = list(seg_names)
         rounds = 0
+        killed = False  # watchdog kill absorbed as a partial result
+        capacity_rejections = 0  # ReservationError count this scatter
+        non_capacity_failure = False  # any genuine server fault seen
         try:
             while pending:
                 with trace.span(f"round:{rounds}", segments=len(pending)):
@@ -594,6 +683,15 @@ class Broker:
                         table, pending, exclude=frozenset(excluded), partial_ok=True
                     )
                     if unroutable:
+                        if capacity_rejections and not non_capacity_failure and not allow_partial:
+                            # every replica was excluded for CAPACITY, not
+                            # faults: surface the overload signal (503
+                            # SERVER_OUT_OF_CAPACITY), not "no live replica"
+                            raise ReservationError(
+                                f"segment(s) {sorted(unroutable)} of table {table!r}: "
+                                f"every replica out of capacity",
+                                query_id=qid,
+                            )
                         self._absorb_unroutable(table, unroutable, excluded, allow_partial, stats)
                     failed: List[str] = []
                     for server_name, segs in assign.items():
@@ -613,12 +711,57 @@ class Broker:
                         ) as ssp:
                             try:
                                 res, sstats = server.execute(
-                                    ctx, segs, table_schema=meta.schema, deadline=per_call
+                                    ctx, segs, table_schema=meta.schema, deadline=per_call,
+                                    cancel=cancel,
                                 )
                             except Exception as e:  # noqa: BLE001 — every fault is recorded below
                                 self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
                                 if isinstance(e, QueryTimeoutError) and deadline.expired():
                                     raise  # the QUERY is out of budget, not just this server
+                                if isinstance(e, QueryKilledError):
+                                    # watchdog kill: punish the adaptive stats
+                                    # EXACTLY once (the killed query consumed
+                                    # this server's time), breaker untouched
+                                    # (the server is healthy — the query died)
+                                    self.server_stats.punish(server_name)
+                                    METRICS.counter("broker.queriesKilled").inc()
+                                    stats.exceptions.append(
+                                        {
+                                            "errorCode": "QUERY_KILLED",
+                                            "message": f"server {server_name}: {e}",
+                                            "server": server_name,
+                                            "reason": e.reason,
+                                        }
+                                    )
+                                    if ssp is not None:
+                                        ssp.annotate(killed=e.reason)
+                                    if allow_partial:
+                                        stats.partial_result = True
+                                        METRICS.counter("broker.partialResults").inc()
+                                        killed = True
+                                        break  # surviving results ship as-is
+                                    e.query_id = qid
+                                    raise
+                                if isinstance(e, ReservationError):
+                                    # capacity, not a fault: fail the segments
+                                    # over without punishing or opening the
+                                    # breaker (quarantining a full server
+                                    # would amplify the overload)
+                                    excluded.add(server_name)
+                                    failed.extend(segs)
+                                    capacity_rejections += 1
+                                    stats.exceptions.append(
+                                        {
+                                            "errorCode": "SERVER_OUT_OF_CAPACITY",
+                                            "message": f"server {server_name}: {e}",
+                                            "server": server_name,
+                                        }
+                                    )
+                                    METRICS.counter("broker.scatterCapacityRejections").inc()
+                                    if ssp is not None:
+                                        ssp.annotate(capacity="rejected")
+                                    continue
+                                non_capacity_failure = True
                                 self.server_stats.punish(server_name)
                                 self.health.record_failure(server_name)
                                 excluded.add(server_name)
@@ -653,6 +796,8 @@ class Broker:
                             if ssp is not None:
                                 ssp.annotate(docs=sstats.num_docs_scanned)
                 pending = failed
+                if killed:
+                    break  # partial-result kill: no failover for what's left
                 if pending:
                     rounds += 1
                     if rounds > max_retries:
@@ -661,6 +806,13 @@ class Broker:
                             f"tried replica after {max_retries} failover round(s)"
                         )
                         if not allow_partial:
+                            if capacity_rejections and not non_capacity_failure:
+                                # every tried replica was at capacity: this is
+                                # an overload rejection, not a scatter fault
+                                raise ReservationError(
+                                    f"{msg}: every replica out of capacity",
+                                    query_id=qid,
+                                )
                             raise ScatterGatherError(msg, stats.exceptions)
                         stats.partial_result = True
                         stats.exceptions.append(

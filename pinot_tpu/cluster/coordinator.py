@@ -70,6 +70,18 @@ class Coordinator:
 
     # -- instance lifecycle (Helix participant analog) -------------------
     def register_server(self, server) -> None:
+        # attach the per-server HBM reservation ledger (admission tentpole):
+        # scatter calls reserve their working-set estimate against it before
+        # launching, so concurrent queries can't jointly overcommit HBM.
+        # Constructed outside the membership lock (it publishes a gauge).
+        if getattr(server, "budget", None) is None:
+            from pinot_tpu.cluster.admission import ResourceBudget, default_server_hbm_budget
+
+            hbm = default_server_hbm_budget()
+            if hbm > 0:
+                server.budget = ResourceBudget(
+                    hbm, gauge=f"server.reservedBytes.{server.name}"
+                )
         with self._membership_lock:
             self.servers[server.name] = server
             self.live.add(server.name)
@@ -158,12 +170,17 @@ class Coordinator:
                 "max": c.stats.max_value,
                 "dictFp": c.dictionary.fingerprint() if c.has_dictionary else None,
             }
+        from pinot_tpu.cluster.server import _segment_bytes
+
         return {
             "numDocs": segment.num_docs,
             "timeRange": segment.time_range,
             "partition": part,
             "creationTimeMs": segment.creation_time_ms,
             "colStats": col_stats,
+            # host-array residency: the broker's per-query cost estimator
+            # sizes HBM working sets from this without touching segment data
+            "bytes": _segment_bytes(segment),
         }
 
     def _assign(self, meta: TableMeta, seg_name: str) -> List[str]:
@@ -356,16 +373,24 @@ class Coordinator:
         """SegmentStatusChecker: per-table replica health."""
         with self._membership_lock:
             live = set(self.live)
+            servers = dict(self.servers)
+        # per-server HBM reservation occupancy (admission ledger view)
+        reserved = {}
+        for name, srv in servers.items():
+            budget = getattr(srv, "budget", None)
+            if budget is not None:
+                reserved[name] = budget.snapshot()
         out: Dict[str, Dict] = {}
         for table, meta in self.tables.items():
             under = []
-            for seg, servers in meta.ideal.items():
-                n_live = sum(1 for s in servers if s in live)
-                if n_live < min(self.replication, len(servers)) or n_live == 0:
+            for seg, seg_servers in meta.ideal.items():
+                n_live = sum(1 for s in seg_servers if s in live)
+                if n_live < min(self.replication, len(seg_servers)) or n_live == 0:
                     under.append(seg)
             out[table] = {
                 "segments": len(meta.ideal),
                 "underReplicated": under,
                 "liveServers": sorted(live),
+                "reservedBytes": reserved,
             }
         return out

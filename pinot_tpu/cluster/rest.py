@@ -111,6 +111,12 @@ class QueryServer:
                             return
                         limit = int(qs.get("limit", ["0"])[0]) or None
                         self._send(200, {"queries": slow.snapshot(limit)})
+                    elif url.path == "/debug/admission":
+                        gov = getattr(outer.engine, "governor", None)
+                        if gov is None:
+                            self._send(404, {"error": "engine has no resource governor"})
+                            return
+                        self._send(200, gov.snapshot())
                     elif url.path.startswith("/cursors/"):
                         parts = url.path.strip("/").split("/")
                         cid = parts[1]
@@ -143,6 +149,11 @@ class QueryServer:
                     self._send(200, payload)
                 except Exception as e:  # noqa: BLE001 - boundary
                     from pinot_tpu.analysis.plan_check import PlanCheckError
+                    from pinot_tpu.cluster.admission import (
+                        QueryKilledError,
+                        ReservationError,
+                        TooManyRequestsError,
+                    )
                     from pinot_tpu.cluster.broker import (
                         NoReplicaAvailableError,
                         QuotaExceededError,
@@ -154,6 +165,41 @@ class QueryServer:
                         # the reference's 429 QUERY_QUOTA_EXCEEDED contract:
                         # throttled clients must be able to back off
                         self._send(429, {"error": str(e), "errorCode": "QUERY_QUOTA_EXCEEDED"})
+                    elif isinstance(e, TooManyRequestsError):
+                        # admission shed: over the cost-rate budget, rejected
+                        # up front with the minted query id for correlation
+                        self._send(
+                            429,
+                            {
+                                "error": str(e),
+                                "errorCode": "TOO_MANY_REQUESTS_ERROR",
+                                "requestId": e.query_id,
+                            },
+                        )
+                    elif isinstance(e, QueryKilledError):
+                        # watchdog killed it mid-flight and the query did not
+                        # allow partial results: retryable 503 with the reason
+                        self._send(
+                            503,
+                            {
+                                "error": str(e),
+                                "errorCode": "QUERY_KILLED",
+                                "requestId": e.query_id,
+                                "reason": e.reason,
+                            },
+                        )
+                    elif isinstance(e, ReservationError):
+                        # HBM/host reservation refused: the tier is at
+                        # capacity RIGHT NOW — retryable as queries drain.
+                        # Checked before the AdmissionError base class below.
+                        self._send(
+                            503,
+                            {
+                                "error": str(e),
+                                "errorCode": "SERVER_OUT_OF_CAPACITY",
+                                "requestId": e.query_id,
+                            },
+                        )
                     elif isinstance(e, QueryTimeoutError):
                         # deadline blew anywhere in the scatter: 408, the
                         # reference's EXECUTION_TIMEOUT_ERROR contract

@@ -22,10 +22,11 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from pinot_tpu.cluster.admission import QueryKilledError, ResourceBudget
 from pinot_tpu.query import executor
 from pinot_tpu.query.ir import QueryContext
 from pinot_tpu.query.result import ExecutionStats
-from pinot_tpu.query.safety import Deadline, QueryTimeoutError
+from pinot_tpu.query.safety import Deadline, QueryTimeoutError, estimate_segment_bytes
 from pinot_tpu.segment.segment import ImmutableSegment
 from pinot_tpu.utils.metrics import METRICS
 
@@ -42,13 +43,18 @@ def _segment_bytes(segment: ImmutableSegment) -> int:
 
 
 class ServerInstance:
-    def __init__(self, name: str, device=None, fault_plan=None):
+    def __init__(self, name: str, device=None, fault_plan=None, budget=None):
         self.name = name
         self.device = device
         # table -> {segment name -> segment}
         self.segments: Dict[str, Dict[str, ImmutableSegment]] = {}
         # cluster.faults.FaultPlan hook (None in production)
         self.fault_plan = fault_plan
+        # HBM reservation ledger (cluster.admission.ResourceBudget): every
+        # scatter call reserves its working-set estimate before launching so
+        # concurrent queries can't jointly overcommit device memory.  None
+        # disables tracking; the coordinator attaches one at registration.
+        self.budget: Optional[ResourceBudget] = budget
 
     # -- data manager ----------------------------------------------------
     def add_segment(self, table: str, segment: ImmutableSegment) -> None:
@@ -75,9 +81,19 @@ class ServerInstance:
         seg_names: List[str],
         table_schema=None,
         deadline: Optional[Deadline] = None,
+        cancel=None,
     ):
         """Run one query over the named LOCAL segments; returns
         (segment results, stats) — the DataTable the reference ships back.
+
+        `cancel`: optional zero-arg probe (the broker watchdog's closure)
+        returning a kill reason or None — checked between kernels alongside
+        the deadline, so a killed query abandons its pending launches the
+        same cooperative way a timed-out one does.  When `self.budget` is
+        set, the working-set estimate for the named segments is reserved
+        before any launch and released on exit (success, timeout, or kill) —
+        a ReservationError here means this server is at capacity and the
+        broker should fail the segments over to another replica.
 
         Tracing (ctx option `trace`): builds a per-server span subtree —
         dispatch (host-side plan+ship+async-launch per segment), device_wait
@@ -89,70 +105,99 @@ class ServerInstance:
         from pinot_tpu.utils.metrics import Trace
 
         trace = Trace(bool(ctx.options.get("trace", False)), root=f"server:{self.name}")
-        plan = self.fault_plan
-        if plan is not None:
-            fault_n0 = len(plan.log)
-            plan.on_execute(self.name)  # may sleep, flap liveness, or raise
-            if trace.enabled and len(plan.log) > fault_n0:
-                trace.annotate(faults=[k for (_, _, k, _) in plan.log[fault_n0:]])
-        stats = ExecutionStats()
-        results = []
-        pending = []
-        with trace.span("dispatch") as dsp:
+        ticket = None
+        if self.budget is not None:
+            # working-set estimate for the batch, reserved all-or-nothing
+            # BEFORE any kernel launches (host-side arithmetic only — no
+            # device values touched, so the warm path stays sync-free)
+            need = 0
             for name in seg_names:
-                self._check_budget(deadline, cancelled=len(pending))
                 seg = self.get_segment(ctx.table, name)
-                if seg is not None and plan is not None and plan.segment_dropped(self.name, ctx.table, name):
-                    seg = None
-                if seg is None:
-                    raise KeyError(f"server {self.name} does not serve {ctx.table}/{name}")
-                stats.num_segments_queried += 1
-                stats.total_docs += seg.num_docs
-                if table_schema is not None:
-                    seg.ensure_columns(table_schema, _needed_columns(ctx, seg))
-                if executor.prune_segment(ctx, seg):
-                    stats.num_segments_pruned += 1
-                    continue
-                # pipelined: dispatch all kernels async, then drain (executor.py)
-                with trace.span(f"launch:{seg.name}"):
-                    pending.append(executor.launch_segment(ctx, seg, device=self.device))
-            if dsp is not None:
-                dsp.annotate(launches=len(pending))
-        if trace.enabled:
-            # device/host time split: ONE fence over every pending output
-            # (trace-only — the untraced path lets collect's device_get be
-            # the fence so cancellation stays responsive between collects)
-            import jax
+                if seg is not None:
+                    need += estimate_segment_bytes(ctx, seg, _needed_columns(ctx, seg))
+            ticket = self.budget.reserve(need, what=f"scatter to server {self.name}")
+        try:
+            plan = self.fault_plan
+            if plan is not None:
+                fault_n0 = len(plan.log)
+                plan.on_execute(self.name)  # may sleep, flap liveness, or raise
+                if trace.enabled and len(plan.log) > fault_n0:
+                    trace.annotate(faults=[k for (_, _, k, _) in plan.log[fault_n0:]])
+            stats = ExecutionStats()
+            results = []
+            pending = []
+            with trace.span("dispatch") as dsp:
+                for name in seg_names:
+                    self._check_budget(deadline, cancelled=len(pending), cancel=cancel)
+                    seg = self.get_segment(ctx.table, name)
+                    if seg is not None and plan is not None and plan.segment_dropped(self.name, ctx.table, name):
+                        seg = None
+                    if seg is None:
+                        raise KeyError(f"server {self.name} does not serve {ctx.table}/{name}")
+                    stats.num_segments_queried += 1
+                    stats.total_docs += seg.num_docs
+                    if table_schema is not None:
+                        seg.ensure_columns(table_schema, _needed_columns(ctx, seg))
+                    if executor.prune_segment(ctx, seg):
+                        stats.num_segments_pruned += 1
+                        continue
+                    # pipelined: dispatch all kernels async, then drain (executor.py)
+                    with trace.span(f"launch:{seg.name}"):
+                        pending.append(executor.launch_segment(ctx, seg, device=self.device))
+                if dsp is not None:
+                    dsp.annotate(launches=len(pending))
+            if trace.enabled:
+                # device/host time split: ONE fence over every pending output
+                # (trace-only — the untraced path lets collect's device_get be
+                # the fence so cancellation stays responsive between collects)
+                import jax
 
-            with trace.span("device_wait", launches=len(pending)):
-                jax.block_until_ready(executor.pending_outputs(pending))
-        for i, st in enumerate(pending):
-            self._check_budget(deadline, cancelled=len(pending) - i)
-            with trace.span("collect") as csp:
-                res, seg_stats = executor.collect_segment(st)
-            if csp is not None:
-                csp.annotate(docs=seg_stats.num_docs_scanned)
-            stats.num_segments_processed += 1
-            stats.num_docs_scanned += seg_stats.num_docs_scanned
-            stats.add_index_uses(seg_stats.filter_index_uses)
-            results.append(res)
-        if trace.enabled:
-            from pinot_tpu import ops
+                with trace.span("device_wait", launches=len(pending)):
+                    jax.block_until_ready(executor.pending_outputs(pending))
+            for i, st in enumerate(pending):
+                self._check_budget(deadline, cancelled=len(pending) - i, cancel=cancel)
+                with trace.span("collect") as csp:
+                    res, seg_stats = executor.collect_segment(st)
+                if csp is not None:
+                    csp.annotate(docs=seg_stats.num_docs_scanned)
+                stats.num_segments_processed += 1
+                stats.num_docs_scanned += seg_stats.num_docs_scanned
+                stats.add_index_uses(seg_stats.filter_index_uses)
+                results.append(res)
+            if trace.enabled:
+                from pinot_tpu import ops
 
-            trace.annotate(
-                server=self.name,
-                segments=len(seg_names),
-                segmentsPruned=stats.num_segments_pruned,
-                docsScanned=stats.num_docs_scanned,
-                backend=ops.scan_backend(),
-            )
-            stats.trace = trace.finish()
-        return results, stats
+                trace.annotate(
+                    server=self.name,
+                    segments=len(seg_names),
+                    segmentsPruned=stats.num_segments_pruned,
+                    docsScanned=stats.num_docs_scanned,
+                    backend=ops.scan_backend(),
+                )
+                stats.trace = trace.finish()
+            return results, stats
+        finally:
+            if ticket is not None:
+                self.budget.release(ticket)
 
-    def _check_budget(self, deadline: Optional[Deadline], cancelled: int) -> None:
-        """Between-kernel deadline check.  On expiry the still-pending
-        launches are abandoned uncollected (their references die with this
-        frame — the async dispatches finish on device but never sync back)."""
+    def _check_budget(
+        self, deadline: Optional[Deadline], cancelled: int, cancel=None
+    ) -> None:
+        """Between-kernel deadline + kill probe.  On expiry or kill the
+        still-pending launches are abandoned uncollected (their references
+        die with this frame — the async dispatches finish on device but
+        never sync back)."""
+        if cancel is not None:
+            reason = cancel()
+            if reason:
+                if cancelled:
+                    METRICS.counter("server.launchesCancelled").inc(cancelled)
+                METRICS.counter("server.queriesKilled").inc()
+                raise QueryKilledError(
+                    f"server {self.name}: query killed ({reason}); "
+                    f"{cancelled} pending launch(es) abandoned",
+                    reason=reason,
+                )
         if deadline is not None and deadline.expired():
             if cancelled:
                 METRICS.counter("server.launchesCancelled").inc(cancelled)

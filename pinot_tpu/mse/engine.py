@@ -173,7 +173,13 @@ class MultiStageEngine:
         self.mesh = mesh
         self.axis = axis
         self.tables: Dict[str, Any] = tables if tables is not None else {}
-        self._plan_cache = LruCache(max_entries=_plan_cache_entries(), name="compile.mse")
+        # plan-cache bytes charge the process host ledger the admission
+        # controller tracks (runtime import: admission is cluster-layer)
+        from pinot_tpu.cluster.admission import process_host_budget
+
+        self._plan_cache = LruCache(
+            max_entries=_plan_cache_entries(), name="compile.mse", budget=process_host_budget()
+        )
 
     @property
     def num_devices(self) -> int:

@@ -208,7 +208,13 @@ class DistributedEngine:
         self.mesh = mesh
         self.axis = axis
         self.tables: Dict[str, Any] = {}  # name -> StackedTable
-        self._plan_cache = LruCache(max_entries=_plan_cache_entries(), name="compile.dist")
+        # plan-cache bytes charge the process host ledger the admission
+        # controller tracks (runtime import: admission is cluster-layer)
+        from pinot_tpu.cluster.admission import process_host_budget
+
+        self._plan_cache = LruCache(
+            max_entries=_plan_cache_entries(), name="compile.dist", budget=process_host_budget()
+        )
         # shape fp + hit/miss of the most recent _plan call (trace/EXPLAIN
         # ANALYZE annotation; the engine plans one query at a time)
         self._last_shape_fp: str = ""
@@ -821,6 +827,16 @@ class DistributedEngine:
         # the old fully-serialized loop).  The fence is a device_get of the
         # oldest launch's output — never a per-launch block_until_ready.
         depth = max(1, int(self.pipeline_depth))
+        # graceful degradation: under process-wide memory pressure (broker
+        # admission controller, cluster/admission.py) the pipeline sheds
+        # in-flight launches — one fewer capture copy resident in HBM per
+        # pressure level past 1, down to a fully serialized loop
+        from pinot_tpu.cluster.admission import current_pressure_level, pipeline_depth_under_pressure
+
+        pressure = current_pressure_level()
+        if pressure:
+            depth = pipeline_depth_under_pressure(depth, pressure)
+            trace.annotate(pressure=pressure)
         # device merge consumes sparse outputs in-graph: keep them on device
         keep_device = plan.kind == "groupby_sparse" and plan.sparse_merge_fn is not None
         batch_outs = []

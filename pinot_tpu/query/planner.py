@@ -167,6 +167,19 @@ def plan_cache_clear() -> None:
     _PLAN_CACHE.clear()
 
 
+def attach_plan_cache_budget(budget) -> None:
+    """Charge the SSE plan cache's byte accounting to a shared host ledger
+    (cluster.admission.ResourceBudget) — the broker attaches its admission
+    budget here so cached plans + cached results + in-flight working sets
+    all bound against ONE budget.  Clears the cache on first attach so every
+    resident entry is charged exactly once; idempotent for the same ledger
+    (repeat broker constructions must not cold the cache)."""
+    if _PLAN_CACHE.budget is budget:
+        return
+    _PLAN_CACHE.clear()
+    _PLAN_CACHE.budget = budget
+
+
 def plan_cache_size() -> int:
     return len(_PLAN_CACHE)
 

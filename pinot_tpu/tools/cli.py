@@ -9,6 +9,7 @@ PostQuery, StartServiceManager/quickstart commands).
   python -m pinot_tpu.tools.cli quickstart
   python -m pinot_tpu.tools.cli lint [paths...]
   python -m pinot_tpu.tools.cli slow-queries --url http://127.0.0.1:8099
+  python -m pinot_tpu.tools.cli admission --url http://127.0.0.1:8099
 """
 from __future__ import annotations
 
@@ -157,6 +158,44 @@ def cmd_slow_queries(args) -> int:
     return 0
 
 
+def cmd_admission(args) -> int:
+    """Print a serving endpoint's overload-protection state (GET
+    /debug/admission): pressure level, admission bucket, host-budget ledger,
+    active queries, and the recent kill ring."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/debug/admission"
+    with urllib.request.urlopen(url) as resp:
+        payload = json.loads(resp.read().decode("utf-8"))
+    if args.json:
+        print(json.dumps(payload, indent=2))
+        return 0
+    adm = payload.get("admission", {})
+    host = payload.get("hostBudget", {})
+    dog = payload.get("watchdog", {})
+    print(f"pressure level : {payload.get('pressureLevel', 0)}")
+    print(
+        f"admission      : rate={adm.get('rate', 0):g} units/s "
+        f"tokens={adm.get('tokens', 0):g}/{adm.get('burst', 0):g} "
+        f"waiting={adm.get('waiting', 0)}/{adm.get('maxQueue', 0)}"
+    )
+    print(
+        f"host budget    : {host.get('inUseBytes', 0) / 1e6:.1f} / "
+        f"{host.get('budgetBytes', 0) / 1e6:.1f} MB in use "
+        f"(peak {host.get('peakBytes', 0) / 1e6:.1f} MB, "
+        f"{host.get('reservations', 0)} reservation(s))"
+    )
+    print(f"active queries : {dog.get('activeQueries', 0)}")
+    kills = dog.get("kills", [])
+    for k in kills:
+        print(
+            f"  killed {k.get('queryId')} after {k.get('elapsedMs', 0):.1f} ms "
+            f"({k.get('reservedBytes', 0) / 1e6:.1f} MB reserved): {k.get('reason')}"
+        )
+    print(f"-- {len(kills)} kill record(s)", file=sys.stderr)
+    return 0
+
+
 def cmd_lint(args) -> int:
     """Static lint: per-file rules (analysis/repo_lint.py) plus the
     interprocedural passes (analysis/engine.py — race detector + sync
@@ -234,6 +273,11 @@ def main(argv=None) -> int:
     sq.add_argument("--limit", type=int, default=20)
     sq.add_argument("--json", action="store_true", help="dump raw entries as JSON")
     sq.set_defaults(fn=cmd_slow_queries)
+
+    ad = sub.add_parser("admission", help="print a serving endpoint's overload-protection state")
+    ad.add_argument("--url", default="http://127.0.0.1:8099", help="query server base URL")
+    ad.add_argument("--json", action="store_true", help="dump the raw snapshot as JSON")
+    ad.set_defaults(fn=cmd_admission)
 
     lt = sub.add_parser("lint", help="JAX-aware static lint over the pinot_tpu tree")
     lt.add_argument("paths", nargs="*", help="python files to lint (default: the installed package)")

@@ -78,6 +78,7 @@ class LruCache:
         ttl_s: Optional[float] = None,
         name: Optional[str] = None,
         sizeof: Callable[[Any], int] = estimate_size,
+        budget=None,
     ) -> None:
         if max_entries is None and max_bytes is None:
             raise ValueError("LruCache needs max_entries and/or max_bytes")
@@ -86,11 +87,30 @@ class LruCache:
         self.ttl_s = ttl_s
         self.name = name
         self._sizeof = sizeof
+        # optional shared byte ledger (cluster.admission.ResourceBudget):
+        # retained bytes charge the SAME budget the admission controller
+        # reserves query working sets from, so caches + in-flight queries
+        # can never jointly overcommit host memory.  Lock order is always
+        # cache lock -> budget lock (the budget never calls back into us).
+        self.budget = budget
         self.clock = time.monotonic  # injectable for deterministic TTL tests
         self._lock = threading.Lock()
         # key -> (value, nbytes, inserted_at_monotonic)
         self._entries: "OrderedDict[Hashable, Tuple[Any, int, float]]" = OrderedDict()
         self._bytes = 0
+
+    def _charge(self, nbytes: int) -> bool:
+        """Charge the shared budget (True when admitted or no budget)."""
+        if self.budget is None or nbytes <= 0:
+            return True
+        ok = self.budget.try_charge(nbytes)
+        if not ok:
+            self._count("budgetRejected")
+        return ok
+
+    def _uncharge(self, nbytes: int) -> None:
+        if self.budget is not None and nbytes > 0:
+            self.budget.uncharge(nbytes)
 
     # -- metrics -----------------------------------------------------------
     def _count(self, event: str, n: int = 1) -> None:
@@ -110,6 +130,7 @@ class LruCache:
             if entry is not None and self.ttl_s is not None and now - entry[2] > self.ttl_s:
                 self._entries.pop(key)
                 self._bytes -= entry[1]
+                self._uncharge(entry[1])
                 self._publish_size_locked()
                 entry = None
             if entry is None:
@@ -120,22 +141,36 @@ class LruCache:
             return entry[0]
 
     def put(self, key: Hashable, value: Any, nbytes: Optional[int] = None) -> None:
-        size = self._sizeof(value) if (nbytes is None and self.max_bytes is not None) else (nbytes or 0)
+        track = self.max_bytes is not None or self.budget is not None
+        size = self._sizeof(value) if (nbytes is None and track) else (nbytes or 0)
         if self.max_bytes is not None and size > self.max_bytes:
             return  # an entry larger than the whole cache never admits
+        evicted = 0
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
-            self._entries[key] = (value, size, self.clock())
-            self._bytes += size
-            evicted = 0
-            while (self.max_entries is not None and len(self._entries) > self.max_entries) or (
-                self.max_bytes is not None and self._bytes > self.max_bytes
-            ):
+                self._uncharge(old[1])
+            # shared-budget admission: evict our own LRU tail to make room
+            # before giving up — the cache yields to in-flight queries, the
+            # budget never yields to the cache
+            admitted = self._charge(size)
+            while not admitted and self._entries:
                 _k, (_v, sz, _t) = self._entries.popitem(last=False)
                 self._bytes -= sz
+                self._uncharge(sz)
                 evicted += 1
+                admitted = self._charge(size)
+            if admitted:
+                self._entries[key] = (value, size, self.clock())
+                self._bytes += size
+                while (self.max_entries is not None and len(self._entries) > self.max_entries) or (
+                    self.max_bytes is not None and self._bytes > self.max_bytes
+                ):
+                    _k, (_v, sz, _t) = self._entries.popitem(last=False)
+                    self._bytes -= sz
+                    self._uncharge(sz)
+                    evicted += 1
             self._publish_size_locked()
         if evicted:
             self._count("evictions", evicted)
@@ -145,6 +180,7 @@ class LruCache:
             entry = self._entries.pop(key, None)
             if entry is not None:
                 self._bytes -= entry[1]
+                self._uncharge(entry[1])
                 self._publish_size_locked()
             return entry is not None
 
@@ -157,12 +193,14 @@ class LruCache:
             for k in doomed:
                 _v, sz, _t = self._entries.pop(k)
                 self._bytes -= sz
+                self._uncharge(sz)
             self._publish_size_locked()
             return len(doomed)
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._uncharge(self._bytes)
             self._bytes = 0
             self._publish_size_locked()
 

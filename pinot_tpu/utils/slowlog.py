@@ -78,7 +78,15 @@ class SlowQueryLog:
         }
         if error is not None:
             entry["error"] = error
-        if time_ms >= self.slow_ms or error is not None:
+        # watchdog kill record: a killed-but-partial query carries its
+        # QUERY_KILLED exception entry (query id, reason, server) — surface
+        # it top-level so /debug/queries and the CLI show kills at a glance
+        if stats is not None:
+            for exc in stats.exceptions:
+                if isinstance(exc, dict) and exc.get("errorCode") == "QUERY_KILLED":
+                    entry["kill"] = exc
+                    break
+        if time_ms >= self.slow_ms or error is not None or "kill" in entry:
             METRICS.counter("broker.slowQueries").inc()
             if stats is not None and stats.trace is not None:
                 entry["trace"] = stats.trace

@@ -408,6 +408,109 @@ class TestW008LiteralFingerprintInPlanCacheKey:
         assert _rules(src) == []
 
 
+class TestW015UnboundedServingGrowth:
+    def test_flags_list_append_in_serving_method(self):
+        src = """
+        class Broker:
+            def __init__(self):
+                self.audit = []
+
+            def execute(self, ctx):
+                self.audit.append(ctx.sql)
+        """
+        assert _rules(src, threaded=True) == ["W015"]
+
+    def test_flags_dict_keyed_by_query_id(self):
+        src = """
+        class Broker:
+            def __init__(self):
+                self.results = {}
+
+            def handle(self, query_id, rows):
+                self.results[query_id] = rows
+        """
+        assert _rules(src, threaded=True) == ["W015"]
+
+    def test_flags_setdefault_keyed_by_request_value(self):
+        src = """
+        class Server:
+            def __init__(self):
+                self.inflight = dict()
+
+            def do_POST(self, qid, fut):
+                self.inflight.setdefault(qid, fut)
+        """
+        assert _rules(src, threaded=True) == ["W015"]
+
+    def test_quiet_on_bounded_deque(self):
+        src = """
+        from collections import deque
+
+        class Broker:
+            def __init__(self):
+                self.audit = deque(maxlen=128)
+
+            def execute(self, ctx):
+                self.audit.append(ctx.sql)
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_quiet_with_eviction_evidence(self):
+        src = """
+        class Server:
+            def __init__(self):
+                self.inflight = {}
+
+            def handle(self, query_id, fut):
+                self.inflight[query_id] = fut
+
+            def finish(self, query_id):
+                self.inflight.pop(query_id, None)
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_quiet_when_reassigned_outside_init(self):
+        src = """
+        class Broker:
+            def __init__(self):
+                self.batch = []
+
+            def execute(self, ctx):
+                self.batch.append(ctx.sql)
+
+            def flush(self):
+                self.batch = []
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_quiet_on_bounded_label_key_and_setup_methods(self):
+        src = """
+        class Coordinator:
+            def __init__(self):
+                self.tables = {}
+                self.listeners = []
+
+            def handle(self, table, meta):
+                self.tables[table] = meta  # bounded label space
+
+            def register(self, cb):
+                self.listeners.append(cb)  # setup, not serving
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_rule_is_threaded_scope_only(self):
+        src = """
+        class Recorder:
+            def __init__(self):
+                self.rows = []
+
+            def record(self, row):
+                self.rows.append(row)
+        """
+        assert _rules(src, threaded=False) == []
+        assert _rules(src, threaded=True) == ["W015"]
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

@@ -59,6 +59,16 @@ been bitten by (ADVICE r5) or that silently degrades TPU throughput:
                               exempts the attribute; dict writes keyed by
                               bounded label spaces (table/segment/server
                               names) stay clean.
+  W016 non-durable-write     an `open(..., "w"/"wb")` whose target is a
+                              durability artifact (path mentions checkpoint/
+                              journal/snapshot/manifest/metadata, or the
+                              enclosing function is a commit/persist path)
+                              in a function with no tmp-fsync-replace
+                              discipline (neither os.fsync + os.replace nor
+                              the spi.filesystem durable_write_* helpers).
+                              A crash mid-write then tears the committed
+                              file — exactly the corruption class the
+                              recovery paths quarantine.
 
 Kernel bodies (W001/W002 scope) are functions the module jits: decorated
 with @jax.jit / @partial(jax.jit, ...) or passed by name to jax.jit(...)
@@ -90,6 +100,7 @@ RULES: Dict[str, str] = {
     "W007": "metric/span name interpolates an unbounded value (cardinality explosion)",
     "W008": "literal-baked fingerprint() used as a plan-cache key (use shape_fingerprint)",
     "W015": "unbounded container growth on a cluster serving path (no bound/eviction)",
+    "W016": "non-durable write to a durability path (no tmp-fsync-replace discipline)",
     # interprocedural passes (analysis/races.py, analysis/device_sync.py —
     # run via analysis/engine.py over the whole package, not per-file):
     "W010": "lock-guarded attribute read/written without holding its lock",
@@ -803,6 +814,91 @@ def _check_w015(path: str, tree: ast.AST, findings: List[Finding]) -> None:
                     )
 
 
+# path fragments naming durability artifacts: a torn write here IS data loss
+_W016_PATH_HINTS = ("checkpoint", "journal", "snapshot", "manifest", "metadata")
+# function-name fragments marking commit/persist paths
+_W016_FUNC_HINTS = ("commit", "checkpoint", "journal", "snapshot", "persist")
+
+
+def _check_w016(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """Durable-write discipline: a bare `open(target, "w"/"wb")` aimed at a
+    durability artifact must live in a function that commits via
+    tmp-fsync-replace (os.fsync AND os.replace both called, in any order —
+    the write-ahead idiom) or delegates to the spi.filesystem
+    durable_write_* helpers.  Without that, a crash mid-write leaves a torn
+    half-file where the committed state used to be.  Scope is the enclosing
+    function: the rule checks discipline where the write happens, so a
+    clean helper used from many callers stays clean everywhere."""
+
+    def scope_nodes(body: List[ast.stmt]) -> List[ast.AST]:
+        nodes: List[ast.AST] = []
+        stack: List[ast.AST] = list(body)
+        while stack:
+            n = stack.pop()
+            nodes.append(n)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # nested function: its own discipline, its own pass
+            stack.extend(ast.iter_child_nodes(n))
+        return nodes
+
+    def call_name(n: ast.AST) -> Optional[str]:
+        if not isinstance(n, ast.Call):
+            return None
+        fn = n.func
+        if isinstance(fn, ast.Name):
+            return fn.id
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        return None
+
+    def write_mode(call: ast.Call) -> Optional[str]:
+        mode = call.args[1] if len(call.args) > 1 else next(
+            (k.value for k in call.keywords if k.arg == "mode"), None
+        )
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return mode.value
+        return None
+
+    def scan_scope(func_name: str, body: List[ast.stmt]) -> None:
+        nodes = scope_nodes(body)
+        disciplined = False
+        has_fsync = has_replace = False
+        for n in nodes:
+            name = call_name(n)
+            if name == "fsync":
+                has_fsync = True
+            elif name == "replace":
+                has_replace = True
+            elif name is not None and name.startswith("durable_write"):
+                disciplined = True
+        disciplined = disciplined or (has_fsync and has_replace)
+        if disciplined:
+            return
+        low_fn = func_name.lower()
+        fn_is_commit_path = any(h in low_fn for h in _W016_FUNC_HINTS)
+        for n in nodes:
+            if call_name(n) != "open" or not n.args:
+                continue
+            mode = write_mode(n)
+            if mode is None or not mode.startswith("w"):
+                continue
+            target = ast.unparse(n.args[0]).lower()
+            if fn_is_commit_path or any(h in target for h in _W016_PATH_HINTS):
+                findings.append(
+                    Finding(
+                        path, n.lineno, "W016",
+                        f"open({ast.unparse(n.args[0])}, {mode!r}) writes a durability "
+                        f"artifact in place — commit via tmp + os.fsync + os.replace "
+                        f"(or spi.filesystem.durable_write_*) so a crash can't tear it",
+                    )
+                )
+
+    scan_scope("<module>", getattr(tree, "body", []))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_scope(node.name, node.body)
+
+
 _SUPPRESS_MARK = "pinot-lint:"
 
 
@@ -863,6 +959,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
     _check_w005(path, tree, findings)
     _check_w007(path, tree, findings)
     _check_w008(path, tree, findings)
+    _check_w016(path, tree, findings)
     if threaded:
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)

@@ -17,6 +17,14 @@ metadata maps the reference keeps in ZK (ideal state / external view), and
 State transitions are direct method calls instead of Helix messages; the
 CONTRACTS (replication, min-available-replicas rebalance, routing
 consistency) match the reference.
+
+Durability (PR 8): what the reference persists to ZooKeeper / the segment
+deep store persists here through journal.py (fsync'd JSONL metadata WAL +
+compacted snapshots) and deepstore.py (PinotFS-backed durable segment home
+with CRC-verified download).  rebalance.py moves segments under query load
+with load-before-drop ordering, and faults.py + utils/crashpoints.py form
+the deterministic crash harness (scripted server crash/restart, named
+kill-points inside every commit protocol).
 """
 from pinot_tpu.cluster.admission import (
     AdmissionController,
@@ -37,7 +45,11 @@ from pinot_tpu.cluster.broker import (
     ScatterGatherError,
     ServerHealth,
 )
+from pinot_tpu.cluster.deepstore import SegmentDeepStore
 from pinot_tpu.cluster.faults import FaultPlan, ServerFaultError
+from pinot_tpu.cluster.journal import MetaJournal
+from pinot_tpu.cluster.rebalance import TableRebalancer
+from pinot_tpu.utils.crashpoints import InjectedCrash
 
 __all__ = [
     "Coordinator",
@@ -46,6 +58,10 @@ __all__ = [
     "ServerHealth",
     "FaultPlan",
     "ServerFaultError",
+    "InjectedCrash",
+    "MetaJournal",
+    "SegmentDeepStore",
+    "TableRebalancer",
     "NoReplicaAvailableError",
     "ScatterGatherError",
     "AdmissionController",

@@ -7,19 +7,73 @@ TableRebalancer.rebalance (.../rebalance/TableRebalancer.java:201, contract
 :122-134: never drop below min-available replicas), RetentionManager and
 SegmentStatusChecker periodic tasks.
 
-Re-design: ideal state / external view are plain dicts owned by this object
-(the ZK-free control plane of SURVEY.md §2.6); servers register directly.
+Re-design: ideal state / external view are dicts owned by this object (the
+ZK-free control plane of SURVEY.md §2.6); servers register directly.  What
+the reference persists to ZooKeeper persists here through an optional
+durable metadata journal (cluster/journal.py: fsync'd JSONL + compacted
+snapshots) — every mutation (table CRUD, segment assignment, replica-group
+membership, rebalance commits, retention drops, realtime checkpoint
+pointers) appends before it applies, so a coordinator built over the same
+meta_dir after a crash rebuilds IDENTICAL ideal state, and re-registering
+servers reconcile their local segment sets against it (re-downloading
+missing/corrupt copies from the segment deep store, cluster/deepstore.py).
+The routing view is versioned: every ideal-state or live-set transition
+bumps `version`, so rebalance moves commit a new routing view instead of
+mutating the one in-flight queries routed on.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from pinot_tpu.segment.segment import ImmutableSegment
 from pinot_tpu.spi.config import TableConfig
 from pinot_tpu.spi.schema import Schema
+from pinot_tpu.utils.crashpoints import crash_point
+
+log = logging.getLogger("pinot_tpu.cluster")
+
+
+def _jsonable(v: Any) -> Any:
+    """Journal-safe JSON form: numpy scalars unwrap, tuples/sets become
+    lists, bytes hex-tag themselves (restored by _unjsonable)."""
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (set, frozenset)):
+        return sorted(_jsonable(x) for x in v)
+    if isinstance(v, bytes):
+        return {"__bytes__": v.hex()}
+    return v
+
+
+def _unjsonable(v: Any) -> Any:
+    if isinstance(v, dict):
+        if set(v) == {"__bytes__"}:
+            return bytes.fromhex(v["__bytes__"])
+        return {k: _unjsonable(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_unjsonable(x) for x in v]
+    return v
+
+
+def _restore_seg_meta(sm: Dict[str, Any]) -> Dict[str, Any]:
+    """Segment metadata back from its journaled JSON form: the fields the
+    broker pruners index positionally come back as tuples."""
+    sm = dict(_unjsonable(sm))
+    if sm.get("timeRange") is not None:
+        sm["timeRange"] = tuple(sm["timeRange"])
+    if sm.get("partition") is not None:
+        sm["partition"] = tuple(sm["partition"])
+    return sm
 
 
 @dataclass
@@ -33,7 +87,17 @@ class TableMeta:
 
 
 class Coordinator:
-    def __init__(self, replication: int = 1):
+    def __init__(
+        self,
+        replication: int = 1,
+        meta_dir: Optional[str] = None,
+        deep_store=None,
+    ):
+        """`meta_dir` enables the durable control plane: mutations journal
+        to {meta_dir}/journal.jsonl (+ compacted snapshots) and a fresh
+        Coordinator over the same directory restores identical state.
+        `deep_store` (a SegmentDeepStore or root path) is the durable
+        segment home servers re-download from after a crash."""
         self.replication = replication
         self.tables: Dict[str, TableMeta] = {}
         self.servers: Dict[str, "ServerInstance"] = {}  # noqa: F821
@@ -50,13 +114,158 @@ class Coordinator:
         # live-set transition listeners: fn(server_name, is_up) — brokers
         # subscribe so circuit-breaker state resets when a server recovers
         self._live_listeners: List[Any] = []
+        # versioned routing view: bumps on every ideal-state / live-set
+        # mutation, so rebalance commits a NEW view instead of mutating the
+        # one concurrent queries routed on
+        self.version = 0
+        # realtime table data dirs (journaled so a restored coordinator can
+        # recover_realtime without the caller re-stating them)
+        self._rt_dirs: Dict[str, str] = {}
+        # last journaled realtime checkpoint pointer per (table, partition)
+        self.rt_checkpoints: Dict[str, Dict[int, Dict[str, int]]] = {}
+        if deep_store is not None and not hasattr(deep_store, "has_segment"):
+            from pinot_tpu.cluster.deepstore import SegmentDeepStore
+
+            deep_store = SegmentDeepStore(str(deep_store))
+        self.deep_store = deep_store
+        self.journal = None
+        if meta_dir is not None:
+            from pinot_tpu.cluster.journal import MetaJournal
+
+            self.journal = MetaJournal(meta_dir)
+            if not self._restore():
+                # fresh journal: pin the cluster-wide invariants so a
+                # restored coordinator doesn't fall back to ctor defaults
+                self._journal(
+                    "init",
+                    replication=self.replication,
+                    numReplicaGroups=self.num_replica_groups,
+                )
+
+    # -- durable control plane -------------------------------------------
+    def _journal(self, op: str, **data: Any) -> None:
+        if self.journal is None:
+            return
+        self.journal.append(op, **data)
+        if self.journal.should_compact():
+            self.journal.snapshot(self._state_dict())
+
+    def _bump_version(self) -> None:
+        with self._membership_lock:
+            self.version += 1
+
+    def _state_dict(self) -> Dict[str, Any]:
+        """Full snapshot-able control-plane state (everything a restarted
+        coordinator needs to rebuild identical ideal state)."""
+        with self._membership_lock:
+            groups = dict(self.replica_group)
+        tables = {}
+        for name, meta in self.tables.items():
+            tables[name] = {
+                "schema": meta.schema.to_dict(),
+                "config": meta.config.to_dict(),
+                "ideal": {seg: sorted(srvs) for seg, srvs in meta.ideal.items()},
+                "segmentMeta": _jsonable(meta.segment_meta),
+                "realtimeDataDir": self._rt_dirs.get(name),
+            }
+        return {
+            "replication": self.replication,
+            "numReplicaGroups": self.num_replica_groups,
+            "tables": tables,
+            "replicaGroup": groups,
+            "rtCheckpoints": _jsonable(self.rt_checkpoints),
+        }
+
+    def _restore(self) -> bool:
+        """Rebuild control-plane state from snapshot + journal replay.
+        Servers are NOT live afterwards — they re-register and reconcile.
+        Returns whether any durable state existed."""
+        state, entries = self.journal.load()
+        if state:
+            self._apply_state(state)
+        for entry in entries:
+            self._apply_entry(entry)
+        if state or entries:
+            self._bump_version()
+            return True
+        return False
+
+    def _apply_state(self, state: Dict[str, Any]) -> None:
+        self.replication = int(state.get("replication", self.replication))
+        self.num_replica_groups = int(state.get("numReplicaGroups", self.num_replica_groups))
+        self.replica_group = {
+            str(k): int(v) for k, v in (state.get("replicaGroup") or {}).items()
+        }
+        for name, t in (state.get("tables") or {}).items():
+            meta = TableMeta(
+                schema=Schema.from_dict(t["schema"]),
+                config=TableConfig.from_dict(t["config"]),
+            )
+            meta.ideal = {seg: set(srvs) for seg, srvs in (t.get("ideal") or {}).items()}
+            meta.segment_meta = {
+                seg: _restore_seg_meta(sm) for seg, sm in (t.get("segmentMeta") or {}).items()
+            }
+            self.tables[name] = meta
+            if t.get("realtimeDataDir"):
+                self._rt_dirs[name] = t["realtimeDataDir"]
+        for table, parts in (state.get("rtCheckpoints") or {}).items():
+            self.rt_checkpoints[table] = {
+                int(p): dict(cp) for p, cp in (parts or {}).items()
+            }
+
+    def _apply_entry(self, entry: Dict[str, Any]) -> None:
+        """Replay one journal entry.  Every op is idempotent (set-valued
+        ideal state, last-writer pointers) so the snapshot/journal overlap a
+        crash mid-compaction produces re-applies harmlessly."""
+        op = entry.get("op")
+        if op == "init":
+            self.replication = int(entry.get("replication", self.replication))
+            self.num_replica_groups = int(
+                entry.get("numReplicaGroups", self.num_replica_groups)
+            )
+        elif op == "add_table":
+            name = entry["table"]
+            if name not in self.tables:
+                self.tables[name] = TableMeta(
+                    schema=Schema.from_dict(entry["schema"]),
+                    config=TableConfig.from_dict(entry["config"]),
+                )
+            if entry.get("realtimeDataDir"):
+                self._rt_dirs[name] = entry["realtimeDataDir"]
+        elif op == "drop_table":
+            self.tables.pop(entry["table"], None)
+            self._rt_dirs.pop(entry["table"], None)
+            self.rt_checkpoints.pop(entry["table"], None)
+        elif op == "set_ideal":
+            meta = self.tables.get(entry["table"])
+            if meta is not None:
+                meta.ideal[entry["segment"]] = set(entry["servers"])
+                if entry.get("meta") is not None:
+                    meta.segment_meta[entry["segment"]] = _restore_seg_meta(entry["meta"])
+        elif op == "drop_segment":
+            meta = self.tables.get(entry["table"])
+            if meta is not None:
+                meta.ideal.pop(entry["segment"], None)
+                meta.segment_meta.pop(entry["segment"], None)
+        elif op == "register_server":
+            self.replica_group[entry["server"]] = int(entry["group"])
+        elif op == "rt_checkpoint":
+            self.rt_checkpoints.setdefault(entry["table"], {})[int(entry["partition"])] = {
+                "offset": int(entry["offset"]),
+                "seq": int(entry["segSeq"]),
+            }
+        else:  # forward-compat: unknown ops are recorded, not fatal
+            log.warning("unknown journal op %r (seq %s) ignored", op, entry.get("seq"))
+
+    def checkpoint_metadata(self) -> None:
+        """Force a compacted snapshot now (periodic-task / shutdown hook)."""
+        if self.journal is not None:
+            self.journal.snapshot(self._state_dict())
 
     def on_live_change(self, fn) -> None:
         self._live_listeners.append(fn)
 
     def _notify_live(self, name: str, up: bool) -> None:
-        import logging
-
         from pinot_tpu.utils.metrics import METRICS
 
         for fn in list(self._live_listeners):
@@ -64,9 +273,7 @@ class Coordinator:
                 fn(name, up)
             except Exception:  # noqa: BLE001 — one bad listener must not block transitions
                 METRICS.counter("liveListenerErrors").inc()
-                logging.getLogger("pinot_tpu.cluster").exception(
-                    "live-set listener failed for %s", name
-                )
+                log.exception("live-set listener failed for %s", name)
 
     # -- instance lifecycle (Helix participant analog) -------------------
     def register_server(self, server) -> None:
@@ -85,7 +292,68 @@ class Coordinator:
         with self._membership_lock:
             self.servers[server.name] = server
             self.live.add(server.name)
-            self.replica_group[server.name] = len(self.replica_group) % self.num_replica_groups
+            known = server.name in self.replica_group
+            if not known:
+                self.replica_group[server.name] = len(self.replica_group) % self.num_replica_groups
+            group = self.replica_group[server.name]
+            self.version += 1
+        if not known:
+            # membership is durable state: a restored coordinator must place
+            # segments into the same replica groups it journaled
+            self._journal("register_server", server=server.name, group=group)
+        # restart recovery: a (re-)registering server reconciles its local
+        # segment set against the journaled ideal state — re-downloading
+        # missing/corrupt copies from the deep store, dropping stale ones
+        self.reconcile_server(server)
+        self._notify_live(server.name, up=True)
+
+    def reconcile_server(self, server) -> Dict[str, int]:
+        """Bring one server's local segment set in line with ideal state
+        (the Helix state-transition batch a re-joining participant runs).
+        Missing segments restore from the deep store (CRC-verified) or a
+        live peer's copy; segments ideal no longer assigns here drop."""
+        from pinot_tpu.utils.metrics import METRICS
+
+        restored = dropped = missing = 0
+        with self._membership_lock:
+            live = set(self.live)
+        for table, meta in self.tables.items():
+            want = {seg for seg, srvs in meta.ideal.items() if server.name in srvs}
+            have = set(server.segment_names(table))
+            for seg_name in sorted(have - want):
+                server.drop_segment(table, seg_name)
+                dropped += 1
+            for seg_name in sorted(want - have):
+                seg = None
+                if self.deep_store is not None and self.deep_store.has_segment(table, seg_name):
+                    try:
+                        seg = server.restore_segment(table, seg_name, self.deep_store)
+                    except Exception:  # noqa: BLE001 — fall through to a peer copy
+                        METRICS.counter("coordinator.restoreFailures").inc()
+                        log.exception(
+                            "deep-store restore of %s/%s onto %s failed",
+                            table, seg_name, server.name,
+                        )
+                if seg is None:
+                    obj = self._find_segment_object(
+                        table, seg_name, (meta.ideal.get(seg_name, set()) | live) - {server.name}
+                    )
+                    if obj is not None:
+                        server.add_segment(table, obj)
+                        seg = obj
+                if seg is not None:
+                    restored += 1
+                else:
+                    missing += 1
+                    METRICS.counter("coordinator.segmentsUnrecoverable").inc()
+                    log.error(
+                        "segment %s/%s assigned to %s is in neither the deep store "
+                        "nor any live replica", table, seg_name, server.name,
+                    )
+        if restored or dropped:
+            METRICS.counter("coordinator.segmentsRestored").inc(restored)
+            self._bump_version()
+        return {"restored": restored, "dropped": dropped, "missing": missing}
 
     def mark_down(self, name: str) -> None:
         """Liveness loss (Helix session expiry analog): external view drops
@@ -93,6 +361,8 @@ class Coordinator:
         with self._membership_lock:
             was_live = name in self.live
             self.live.discard(name)
+            if was_live:
+                self.version += 1
         if was_live:
             # listeners run outside the lock: they take their own locks
             # (broker breaker reset) and must not order against membership
@@ -103,15 +373,40 @@ class Coordinator:
             recovered = name in self.servers and name not in self.live
             if recovered:
                 self.live.add(name)
+                self.version += 1
         if recovered:
             self._notify_live(name, up=True)
+
+    # -- server crash / restart (process-death simulation harness) --------
+    def crash_server(self, name: str) -> None:
+        """Kill a server: its in-memory/HBM segment state is LOST (the
+        process died), and the external view drops it."""
+        with self._membership_lock:
+            server = self.servers.get(name)
+        if server is not None:
+            server.crash()
+        self.mark_down(name)
+
+    def restart_server(self, name: str) -> Dict[str, int]:
+        """Restart a crashed server: reconcile its (empty) local state
+        against ideal state — re-download committed segments from the deep
+        store, re-pin to device — then rejoin the live set, which heals
+        broker routing/breakers via the mark_up listener path."""
+        with self._membership_lock:
+            server = self.servers[name]
+        server.boot()
+        stats = self.reconcile_server(server)
+        self.mark_up(name)
+        return stats
 
     # -- table CRUD ------------------------------------------------------
     def add_table(self, schema: Schema, config: Optional[TableConfig] = None) -> None:
         cfg = config or TableConfig(name=schema.name)
         if cfg.name in self.tables:
             raise ValueError(f"table {cfg.name} already exists")
+        self._journal("add_table", table=cfg.name, schema=schema.to_dict(), config=cfg.to_dict())
         self.tables[cfg.name] = TableMeta(schema=schema, config=cfg)
+        self._bump_version()
 
     def add_realtime_table(self, schema: Schema, config: TableConfig, data_dir: str, stream=None):
         """Create a REALTIME table owned by the cluster: the coordinator
@@ -120,9 +415,59 @@ class Coordinator:
         the broker serves sealed + consuming segments from it."""
         from pinot_tpu.realtime import RealtimeTableDataManager
 
-        self.add_table(schema, config)
-        mgr = RealtimeTableDataManager(schema, config, data_dir, stream=stream)
-        self.realtime[config.name] = mgr
+        if config.name in self.tables:
+            raise ValueError(f"table {config.name} already exists")
+        self._journal(
+            "add_table",
+            table=config.name,
+            schema=schema.to_dict(),
+            config=config.to_dict(),
+            realtimeDataDir=data_dir,
+        )
+        self.tables[config.name] = TableMeta(schema=schema, config=config)
+        self._rt_dirs[config.name] = data_dir
+        self._bump_version()
+        mgr = RealtimeTableDataManager(
+            schema, config, data_dir, stream=stream, deep_store=self.deep_store
+        )
+        self._attach_realtime(config.name, mgr)
+        return mgr
+
+    def _attach_realtime(self, name: str, mgr) -> None:
+        self.realtime[name] = mgr
+
+        # checkpoint pointers are control-plane state: journal each commit
+        # so a restored coordinator knows the committed (offset, seq) per
+        # partition without touching the table's data dir
+        def _on_checkpoint(partition: int, offset: int, seq: int, _t=name) -> None:
+            self.rt_checkpoints.setdefault(_t, {})[int(partition)] = {
+                "offset": int(offset), "seq": int(seq),
+            }
+            # "segSeq", not "seq": the journal reserves "seq" for its own
+            # append ordering
+            self._journal("rt_checkpoint", table=_t, partition=partition, offset=offset, segSeq=seq)
+
+        mgr.on_checkpoint = _on_checkpoint
+
+    def recover_realtime(self, name: str, stream=None):
+        """Re-create a journaled realtime table's manager after coordinator
+        restart.  The manager replays its own fsync'd checkpoint (sealed
+        segments + committed offsets); `stream` re-binds the live source
+        (memory streams can't be journaled — file/kafka-style configs
+        rebuild from TableConfig alone)."""
+        from pinot_tpu.realtime import RealtimeTableDataManager
+
+        if name in self.realtime:
+            return self.realtime[name]
+        meta = self.tables[name]
+        data_dir = self._rt_dirs.get(name)
+        if data_dir is None:
+            raise KeyError(f"table {name!r} was not journaled as a realtime table")
+        mgr = RealtimeTableDataManager(
+            meta.schema, meta.config, data_dir, stream=stream, deep_store=self.deep_store
+        )
+        self._attach_realtime(name, mgr)
+        self._bump_version()
         return mgr
 
     def run_realtime_consumption(self, max_batches: Optional[int] = None) -> int:
@@ -134,7 +479,11 @@ class Coordinator:
         return total
 
     def drop_table(self, name: str) -> None:
+        self._journal("drop_table", table=name)
         meta = self.tables.pop(name)
+        self.realtime.pop(name, None)
+        self._rt_dirs.pop(name, None)
+        self._bump_version()
         with self._membership_lock:
             servers = dict(self.servers)
         for seg_name, assigned in meta.ideal.items():
@@ -144,17 +493,41 @@ class Coordinator:
 
     # -- segment registration + assignment -------------------------------
     def add_segment(self, table: str, segment: ImmutableSegment) -> List[str]:
-        """addNewSegment -> assignSegment -> server state transitions."""
+        """addNewSegment -> assignSegment -> server state transitions.
+
+        Durability ordering: segment data reaches the deep store FIRST,
+        then the assignment journals, then servers load — a crash at any
+        point leaves metadata that only ever references durable data, and
+        restart reconciliation completes the placement."""
         meta = self.tables[table]
         targets = self._assign(meta, segment.name)
+        if self.deep_store is not None:
+            self.deep_store.put_segment(table, segment)
+        crash_point("coordinator.add_segment.after_upload")
+        seg_meta = self._seg_meta(segment)
+        self._journal(
+            "set_ideal",
+            table=table,
+            segment=segment.name,
+            servers=sorted(targets),
+            meta=_jsonable(seg_meta),
+        )
+        crash_point("coordinator.add_segment.after_journal")
         meta.ideal[segment.name] = set(targets)
-        meta.segment_meta[segment.name] = self._seg_meta(segment)
+        meta.segment_meta[segment.name] = seg_meta
+        self._bump_version()
         with self._membership_lock:
             servers = {s: self.servers[s] for s in targets}
         for s in targets:
             # device placement (HBM pins) happens outside the lock
             servers[s].add_segment(table, segment)
         return targets
+
+    def _set_ideal(self, table: str, seg_name: str, servers: Set[str]) -> None:
+        """Journal + apply one segment's new assignment (rebalance commit)."""
+        self._journal("set_ideal", table=table, segment=seg_name, servers=sorted(servers))
+        self.tables[table].ideal[seg_name] = set(servers)
+        self._bump_version()
 
     def _seg_meta(self, segment: ImmutableSegment) -> Dict:
         part = None
@@ -222,40 +595,27 @@ class Coordinator:
             live = set(self.live)
         return {seg: {s for s in servers if s in live} for seg, servers in meta.ideal.items()}
 
-    # -- rebalance --------------------------------------------------------
-    def rebalance(self, table: str, min_available_replicas: int = 1) -> Dict[str, int]:
-        """Repair/redistribute assignment over the CURRENT live set.
-
-        Contract (TableRebalancer.java:122-134): a segment never has fewer
-        than min_available_replicas live copies during the move — new
-        replicas are added (server.add_segment) BEFORE old ones drop."""
+    def versioned_view(self, table: str) -> Tuple[int, Dict[str, Set[str]]]:
+        """(version, external view) — the version identifies which routing
+        epoch a query's snapshot came from; rebalance/liveness transitions
+        bump it, so two different answers are never attributed to one view."""
         meta = self.tables[table]
-        moved = added = dropped = 0
         with self._membership_lock:
             live = set(self.live)
-            servers = dict(self.servers)
-        for seg_name in list(meta.ideal):
-            current = meta.ideal[seg_name]
-            desired = set(self._assign_for_rebalance(meta, seg_name))
-            if desired == current:
-                continue
-            segment = self._find_segment_object(table, seg_name, current | live)
-            if segment is None:
-                continue  # no live copy to replicate from
-            # add new replicas first (keeps availability)
-            for s in sorted(desired - current):
-                servers[s].add_segment(table, segment)
-                added += 1
-            survivors = {s for s in desired if s in live}
-            for s in sorted(current - desired):
-                if len(survivors) >= min_available_replicas and s in servers:
-                    servers[s].drop_segment(table, seg_name)
-                    dropped += 1
-                else:
-                    desired.add(s)  # keep the old copy: availability floor
-            meta.ideal[seg_name] = desired
-            moved += 1
-        return {"segmentsMoved": moved, "replicasAdded": added, "replicasDropped": dropped}
+            version = self.version
+        view = {seg: {s for s in servers if s in live} for seg, servers in meta.ideal.items()}
+        return version, view
+
+    # -- rebalance --------------------------------------------------------
+    def rebalance(self, table: str, min_available_replicas: int = 1) -> Dict[str, int]:
+        """Live rebalance over the CURRENT live set (TableRebalancer.java
+        :122-134 contract: load-before-drop, never below the availability
+        floor, each move committed to the journal before old copies drop)."""
+        from pinot_tpu.cluster.rebalance import TableRebalancer
+
+        return TableRebalancer(self).rebalance(
+            table, min_available_replicas=min_available_replicas
+        )
 
     def _assign_for_rebalance(self, meta: TableMeta, seg_name: str) -> List[str]:
         return self._assign(meta, seg_name)
@@ -288,10 +648,12 @@ class Coordinator:
             for seg_name in list(meta.ideal):
                 tr = meta.segment_meta.get(seg_name, {}).get("timeRange")
                 if tr is not None and tr[1] is not None and tr[1] < horizon:
+                    self._journal("drop_segment", table=table, segment=seg_name)
                     for s in meta.ideal.pop(seg_name):
                         if s in servers:
                             servers[s].drop_segment(table, seg_name)
                     meta.segment_meta.pop(seg_name, None)
+                    self._bump_version()
                     purged.append(f"{table}/{seg_name}")
         return purged
 
@@ -348,13 +710,7 @@ class Coordinator:
 
     def start_periodic_tasks(self, interval_s: float = 5.0, stop_event=None) -> "threading.Thread":
         """Background periodic-task thread (daemonized)."""
-        import threading
-
-        import logging
-
         from pinot_tpu.utils.metrics import METRICS
-
-        log = logging.getLogger("pinot_tpu.cluster")
 
         def loop():
             while stop_event is None or not stop_event.is_set():

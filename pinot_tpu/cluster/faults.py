@@ -5,11 +5,18 @@ that kill servers mid-query (e.g. OfflineGRPCServerIntegrationTest /
 ServerStarter restarts); here the same chaos is scripted as data.  A
 FaultPlan is a seeded, reproducible schedule of faults keyed by (server,
 call number): fail server S on its Nth scatter call, add fixed latency,
-drop a segment from its local view, flap coordinator liveness.  Hooks live
-in ServerInstance.execute (on_execute / segment_dropped) and the
-coordinator (mark_down / mark_up via flap rules), so every
-failover/quarantine/partial-result path in the broker is driven by tier-1
-tests instead of hoped-for.
+drop a segment from its local view, flap coordinator liveness, CRASH a
+server (process death: its segment state is lost and recovery is a full
+coordinator-driven restart + deep-store reconcile) or restart a crashed
+one mid-workload.  Hooks live in ServerInstance.execute (on_execute /
+segment_dropped) and the coordinator (mark_down / mark_up / crash_server /
+restart_server), so every failover/quarantine/partial-result path in the
+broker is driven by tier-1 tests instead of hoped-for.  Orthogonally,
+kill_at() arms named kill-points (utils/crashpoints.py) sitting between
+the write/rename/swap steps of every commit path — segment seal, journal
+append, snapshot compaction, deep-store upload/download, rebalance move —
+so crash-recovery tests can die at EXACTLY one protocol step and assert
+the restart converges to committed state.
 
 Determinism contract: the same plan (same seed, same builder calls) applied
 to an identically-built cluster produces the same fault sequence, hence the
@@ -31,7 +38,7 @@ class ServerFaultError(RuntimeError):
 
 @dataclass
 class _Rule:
-    kind: str  # "fail" | "latency" | "flap_down" | "flap_up"
+    kind: str  # "fail" | "latency" | "flap_down" | "flap_up" | "crash" | "restart"
     trigger: str  # server whose call counter drives the rule
     target: str  # server the effect applies to (== trigger for fail/latency)
     calls: Optional[Set[int]] = None  # 1-based call numbers; None = every call
@@ -39,8 +46,10 @@ class _Rule:
     message: str = ""
 
 
-# fail raises, so side-effecting rules on the same call apply first
-_APPLY_ORDER = {"latency": 0, "flap_down": 1, "flap_up": 1, "fail": 2}
+# fail/crash raise (crash of the trigger itself), so side-effecting rules on
+# the same call apply first; restarts precede crashes so a restart+crash pair
+# scheduled on one call nets out to "bounced then died" deterministically
+_APPLY_ORDER = {"latency": 0, "restart": 1, "flap_down": 2, "flap_up": 2, "crash": 3, "fail": 4}
 
 
 class FaultPlan:
@@ -54,6 +63,7 @@ class FaultPlan:
         self._calls: Dict[str, int] = {}
         self._coordinator = None
         self._lock = threading.Lock()
+        self._kill_points: List[str] = []  # armed via kill_at, for reset
 
     # -- wiring ----------------------------------------------------------
     def attach(self, coordinator) -> "FaultPlan":
@@ -101,6 +111,43 @@ class FaultPlan:
         self._rules.append(_Rule("flap_up", of or server, server, calls={on_call}))
         return self
 
+    def crash_server(self, server: str, on_call: int = 1, of: Optional[str] = None) -> "FaultPlan":
+        """KILL `server` (process death: segment state lost, external view
+        drops it) when `of` (default: the server itself) receives its Nth
+        call.  Unlike fail_server, recovery requires restart_server — the
+        coordinator reconciles the rebooted server from the deep store."""
+        # plan builder (test-authored, bounded), not a serving path
+        self._rules.append(_Rule("crash", of or server, server, calls={on_call}))  # pinot-lint: disable=W015
+        return self
+
+    def restart_server(self, server: str, on_call: int, of: Optional[str] = None) -> "FaultPlan":
+        """Restart a crashed `server` when `of` receives its Nth call: the
+        coordinator reboots it empty, reconciles from deep store / live
+        peers, and mark_up heals broker breakers mid-workload."""
+        # plan builder (test-authored, bounded), not a serving path
+        self._rules.append(_Rule("restart", of or server, server, calls={on_call}))  # pinot-lint: disable=W015
+        return self
+
+    def kill_at(self, point: str, hit: int = 1) -> "FaultPlan":
+        """Arm a named kill-point (utils/crashpoints.py): the `hit`-th time
+        execution reaches crash_point(point) — between two steps of a commit
+        protocol — InjectedCrash raises, simulating death at that exact
+        instant.  Disarms after firing so the post-restart retry commits."""
+        from pinot_tpu.utils import crashpoints
+
+        crashpoints.arm(point, hit=hit)
+        self._kill_points.append(point)
+        return self
+
+    def reset_kill_points(self) -> "FaultPlan":
+        """Disarm every kill-point this plan armed (test teardown)."""
+        from pinot_tpu.utils import crashpoints
+
+        for p in self._kill_points:
+            crashpoints.disarm(p)
+        self._kill_points.clear()
+        return self
+
     def chaos(self, servers: List[str], p_fail: float, max_calls: int = 8) -> "FaultPlan":
         """Seeded random failures: each (server, call<=max_calls) fails with
         probability p_fail, drawn ONCE at plan-build time from the plan's
@@ -123,13 +170,24 @@ class FaultPlan:
         for r in sorted(due, key=lambda r: _APPLY_ORDER[r.kind]):
             # the fault ledger IS the harness product (tests slice it by
             # index); a deque can't slice, and plans live one test long
-            self.log.append((server_name, n, r.kind, r.target))  # pinot-lint: disable=W015
+            with self._lock:
+                self.log.append((server_name, n, r.kind, r.target))  # pinot-lint: disable=W015
             if r.kind == "latency":
                 self.sleep(r.ms / 1000.0)
             elif r.kind == "flap_down" and self._coordinator is not None:
                 self._coordinator.mark_down(r.target)
             elif r.kind == "flap_up" and self._coordinator is not None:
                 self._coordinator.mark_up(r.target)
+            elif r.kind == "restart" and self._coordinator is not None:
+                self._coordinator.restart_server(r.target)
+            elif r.kind == "crash":
+                if self._coordinator is not None:
+                    self._coordinator.crash_server(r.target)
+                if r.target == server_name:
+                    # the in-flight call on the crashing server dies with it
+                    raise ServerFaultError(
+                        f"injected crash: server {server_name} died (call {n})"
+                    )
             elif r.kind == "fail":
                 raise ServerFaultError(
                     r.message or f"injected fault: server {server_name} died (call {n})"
@@ -139,7 +197,7 @@ class FaultPlan:
         if (server, table, segment) in self._dropped:
             with self._lock:
                 n = self._calls.get(server, 0)
-            self.log.append((server, n, "drop_segment", segment))
+                self.log.append((server, n, "drop_segment", segment))
             return True
         return False
 

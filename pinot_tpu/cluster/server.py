@@ -20,6 +20,7 @@ segments, driving the broker's failover paths deterministically.
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
 
 from pinot_tpu.cluster.admission import QueryKilledError, ResourceBudget
@@ -43,7 +44,7 @@ def _segment_bytes(segment: ImmutableSegment) -> int:
 
 
 class ServerInstance:
-    def __init__(self, name: str, device=None, fault_plan=None, budget=None):
+    def __init__(self, name: str, device=None, fault_plan=None, budget=None, data_dir=None):
         self.name = name
         self.device = device
         # table -> {segment name -> segment}
@@ -55,6 +56,41 @@ class ServerInstance:
         # concurrent queries can't jointly overcommit device memory.  None
         # disables tracking; the coordinator attaches one at registration.
         self.budget: Optional[ResourceBudget] = budget
+        # local segment cache dir for deep-store restores (tempdir fallback)
+        self.data_dir = data_dir
+        # process-death simulation: True between crash() and boot() — every
+        # execute fails like a dead TCP peer until the coordinator restarts
+        # and reconciles this server
+        self.crashed = False
+
+    # -- crash / restart (process-death simulation) -----------------------
+    def crash(self) -> None:
+        """Simulate process death: all in-memory/HBM segment state is lost
+        (gauges zero out with it) and calls fail until boot()."""
+        for table in list(self.segments):
+            for seg_name in list(self.segments[table]):
+                self.drop_segment(table, seg_name)
+        self.segments = {}
+        self.crashed = True
+        METRICS.counter("server.crashes").inc()
+
+    def boot(self) -> None:
+        """Come back up EMPTY — recovery is the coordinator reconciling this
+        server against ideal state (restart_server), not a local replay."""
+        self.crashed = False
+
+    def restore_segment(self, table: str, seg_name: str, deep_store) -> ImmutableSegment:
+        """Re-materialize one committed segment from the deep store: download
+        to the local cache dir, CRC-verify, load, pin (restart recovery and
+        rebalance both land here)."""
+        import tempfile
+
+        if self.data_dir is None:
+            self.data_dir = tempfile.mkdtemp(prefix=f"pinot-server-{self.name}-")
+        local_dir = os.path.join(self.data_dir, table)
+        segment = deep_store.fetch_segment(table, seg_name, local_dir)
+        self.add_segment(table, segment)
+        return segment
 
     # -- data manager ----------------------------------------------------
     def add_segment(self, table: str, segment: ImmutableSegment) -> None:
@@ -104,6 +140,12 @@ class ServerInstance:
         from pinot_tpu.query.planner import _needed_columns
         from pinot_tpu.utils.metrics import Trace
 
+        if self.crashed:
+            from pinot_tpu.cluster.faults import ServerFaultError
+
+            # a dead process looks like a transport error to the broker —
+            # exactly the signal that drives its failover/breaker paths
+            raise ServerFaultError(f"server {self.name} is down (crashed)")
         trace = Trace(bool(ctx.options.get("trace", False)), root=f"server:{self.name}")
         ticket = None
         if self.budget is not None:

@@ -18,7 +18,9 @@ live only in the mutable segment).
 """
 from __future__ import annotations
 
+import copy
 import json
+import logging
 import os
 import threading
 import time
@@ -27,8 +29,13 @@ from typing import Any, Dict, List, Optional
 from pinot_tpu.realtime.mutable import MutableSegment
 from pinot_tpu.realtime.stream import InMemoryStream, PartitionGroupConsumer, make_consumer
 from pinot_tpu.segment.segment import ImmutableSegment
+from pinot_tpu.segment.store import SegmentCorruptError
 from pinot_tpu.spi.config import TableConfig
 from pinot_tpu.spi.schema import Schema
+from pinot_tpu.utils.crashpoints import crash_point
+from pinot_tpu.utils.metrics import METRICS
+
+log = logging.getLogger("pinot_tpu.realtime")
 
 
 def segment_name(table: str, partition: int, seq: int) -> str:
@@ -114,7 +121,15 @@ class RealtimeSegmentDataManager:
         the checkpoint advances, so a crash between the two replays into a
         duplicate *file* (overwritten on rebuild), never into lost rows."""
         sealed = self.mutable.seal(output_dir=self.table.segment_dir(self.mutable.name))
+        crash_point("segment.seal.after_build")
+        # deep-store copy BEFORE the checkpoint references the segment as
+        # committed: once {offset, seq} advances, the segment must survive
+        # the loss of this host's data dir (segment completion protocol)
+        if self.table.deep_store is not None:
+            self.table.deep_store.put_segment(self.table.config.name, sealed)
+        crash_point("segment.seal.after_upload")
         self.table._swap_in(self.partition, sealed)
+        crash_point("segment.seal.after_swap")
         self.seq += 1
         self.table._commit_checkpoint(self.partition, self.offset, self.seq)
         self.segment_start_ms = time.monotonic() * 1000
@@ -150,6 +165,7 @@ class RealtimeTableDataManager:
         data_dir: str,
         stream: Optional[InMemoryStream] = None,
         num_partitions: Optional[int] = None,
+        deep_store=None,
     ):
         if config.stream is None:
             raise ValueError(f"table {config.name} has no streamConfigs")
@@ -157,6 +173,12 @@ class RealtimeTableDataManager:
         self.config = config
         self.data_dir = data_dir
         self.stream = stream
+        # segment deep store (cluster/deepstore.py): sealed segments are
+        # uploaded at commit time and corrupt local copies re-download
+        self.deep_store = deep_store
+        # checkpoint-committed hook: fn(partition, offset, seq), called
+        # AFTER the fsync'd commit — the coordinator journals the pointer
+        self.on_checkpoint = None
         os.makedirs(data_dir, exist_ok=True)
         if num_partitions is None:
             num_partitions = stream.num_partitions if stream is not None else 1
@@ -198,34 +220,107 @@ class RealtimeTableDataManager:
         return os.path.join(self.data_dir, "checkpoint.json")
 
     def _load_checkpoint(self) -> Dict[str, Any]:
+        """Load the committed checkpoint, tolerating the artifacts a crash
+        can leave: stale *.tmp files are swept; a corrupt checkpoint.json is
+        quarantined aside (evidence, not deleted) and the previous committed
+        state (checkpoint.json.bak) — or empty — is recovered instead.
+        Recovery from an older checkpoint is safe by construction: offsets
+        only re-consume, and sealed-segment files overwrite idempotently."""
+        from pinot_tpu.spi.filesystem import sweep_tmp
+
+        sweep_tmp(self.data_dir)
         path = self._checkpoint_path()
-        if os.path.exists(path):
-            with open(path, "r", encoding="utf-8") as f:
-                return json.load(f)
+        for candidate in (path, path + ".bak"):
+            if not os.path.exists(candidate):
+                continue
+            try:
+                with open(candidate, "r", encoding="utf-8") as f:
+                    return json.load(f)
+            except (json.JSONDecodeError, OSError, ValueError) as e:
+                METRICS.counter("realtime.checkpointCorrupt").inc()
+                aside = candidate + ".corrupt"
+                try:
+                    if os.path.exists(aside):
+                        os.remove(aside)
+                    os.replace(candidate, aside)
+                except OSError:
+                    aside = None
+                log.warning(
+                    "corrupt realtime checkpoint %s (%s) quarantined to %s; "
+                    "recovering from previous state", candidate, e, aside,
+                )
         return {}
 
     def _commit_checkpoint(self, partition: int, offset: int, seq: int) -> None:
-        cp = self._checkpoint.setdefault(str(partition), {"offset": 0, "seq": 0, "segments": []})
-        cp["offset"] = offset
-        cp["seq"] = seq
+        """Advance one partition's committed {offset, seq, segments} pointer.
+
+        The shared checkpoint dict is mutated AND deep-copied under _lock —
+        a concurrent partition's commit can neither interleave a half-updated
+        entry into this dump nor mutate a list while json serializes it (the
+        race the old code had by dumping the live dict outside the lock).
+        The dump itself runs on the copy, outside the lock."""
         with self._lock:
+            cp = self._checkpoint.setdefault(str(partition), {"offset": 0, "seq": 0, "segments": []})
+            cp["offset"] = offset
+            cp["seq"] = seq
             cp["segments"] = [s.name for s in self.sealed[partition]]
-        tmp = self._checkpoint_path() + ".tmp"
+            snapshot = copy.deepcopy(self._checkpoint)
+        path = self._checkpoint_path()
+        tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(self._checkpoint, f)
+            json.dump(snapshot, f)
+            crash_point("realtime.checkpoint.after_write")
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, self._checkpoint_path())
+        # keep the last committed checkpoint as the corruption fallback
+        if os.path.exists(path):
+            bak = path + ".bak"
+            try:
+                os.replace(path, bak)
+            except OSError:
+                pass
+        crash_point("realtime.checkpoint.after_bak")
+        os.replace(tmp, path)
+        crash_point("realtime.checkpoint.after_replace")
+        from pinot_tpu.spi.filesystem import fsync_dir
+
+        fsync_dir(self.data_dir)
+        if self.on_checkpoint is not None:
+            self.on_checkpoint(partition, offset, seq)
 
     def _recover_partition(self, partition: int) -> None:
-        """Reload committed sealed segments from disk (restart path)."""
+        """Reload committed sealed segments from disk (restart path),
+        CRC-verifying each; a missing/corrupt local copy re-downloads from
+        the deep store (it was uploaded before the checkpoint committed)."""
         cp = self._checkpoint.get(str(partition))
         if not cp:
             return
+        table_name = self.config.name
         for name in cp.get("segments", []):
             path = self.segment_dir(name)
-            if os.path.isdir(path):
-                self.sealed[partition].append(ImmutableSegment.load(path))
+            seg = None
+            try:
+                if os.path.isdir(path):
+                    seg = ImmutableSegment.load(path, verify=True)
+            except SegmentCorruptError as e:
+                METRICS.counter("realtime.segmentsCorrupt").inc()
+                aside = path + ".corrupt"
+                import shutil
+
+                shutil.rmtree(aside, ignore_errors=True)
+                os.replace(path, aside)
+                log.warning("quarantined corrupt sealed segment %s (%s)", path, e)
+            if seg is None and self.deep_store is not None and self.deep_store.has_segment(table_name, name):
+                seg = self.deep_store.fetch_segment(table_name, name, self.data_dir)
+                METRICS.counter("realtime.segmentsRestored").inc()
+            if seg is not None:
+                self.sealed[partition].append(seg)
+            else:
+                METRICS.counter("realtime.segmentsUnrecoverable").inc()
+                log.error(
+                    "committed sealed segment %s/%s is in neither the data dir "
+                    "nor the deep store", table_name, name,
+                )
 
     # -- swap/roll hooks -------------------------------------------------
     def _swap_in(self, partition: int, sealed: ImmutableSegment) -> None:

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from pinot_tpu.spi.config import StreamConfig
+from pinot_tpu.utils.hashing import partition_of
 
 
 @dataclass
@@ -70,7 +71,12 @@ class InMemoryStream:
     def publish(self, value: Dict[str, Any], key: Optional[Any] = None, partition: Optional[int] = None) -> int:
         with self._lock:
             if partition is None:
-                partition = (hash(key) % self.num_partitions) if key is not None else 0
+                # stable hash (utils/hashing.py murmur2, the Kafka default
+                # partitioner): Python's hash() is salted per process
+                # (PYTHONHASHSEED), so a producer restart would re-route
+                # keys and break partition-affinity invariants (upsert
+                # locality, checkpointed offsets pointing at the wrong log)
+                partition = partition_of(key, self.num_partitions) if key is not None else 0
             log = self._logs[partition]
             msg = StreamMessage(value=value, offset=len(log) + 1, key=key)
             log.append(msg)
@@ -108,6 +114,12 @@ class FileStream(PartitionGroupConsumer):
 
     def __init__(self, path: str):
         self.path = path
+        # incremental-tail memo: byte position of line index _memo_line —
+        # a steady-state consume loop seeks straight to where it left off
+        # instead of re-reading the whole file every fetch (O(total) per
+        # batch made long-running tails quadratic)
+        self._memo_line = 0
+        self._memo_pos = 0
 
     def fetch(self, start_offset: int, max_messages: int = 1024) -> MessageBatch:
         """Offsets are RAW line indices (blank lines consume an offset but
@@ -116,17 +128,39 @@ class FileStream(PartitionGroupConsumer):
         if not os.path.exists(self.path):
             return MessageBatch(messages=[], next_offset=start_offset, end_of_partition=True)
         next_offset = start_offset
-        with open(self.path, "r", encoding="utf-8") as f:
-            for i, line in enumerate(f):
-                if i < start_offset:
-                    continue
+        with open(self.path, "rb") as f:
+            if start_offset == self._memo_line and self._memo_pos > 0:
+                # the memo only short-circuits an append-only file: if it
+                # was truncated/rewritten shorter, fall back to a rescan
+                if os.fstat(f.fileno()).st_size >= self._memo_pos:
+                    f.seek(self._memo_pos)
+                    i = self._memo_line
+                else:
+                    i = 0
+            else:
+                i = 0
+            if i == 0 and start_offset != 0:
+                # skip to start_offset the slow way (cold start / replay)
+                while i < start_offset:
+                    if not f.readline():
+                        break
+                    i += 1
+            for raw in iter(f.readline, b""):
+                if not raw.endswith(b"\n"):
+                    # torn tail: a writer crashed (or is) mid-line — leave it
+                    # unconsumed and park the memo BEFORE the partial bytes
+                    # so the next fetch re-reads the completed line
+                    self._memo_line, self._memo_pos = i, f.tell() - len(raw)
+                    return MessageBatch(messages=msgs, next_offset=next_offset, end_of_partition=True)
                 if len(msgs) >= max_messages:
+                    self._memo_line, self._memo_pos = i, f.tell() - len(raw)
                     return MessageBatch(messages=msgs, next_offset=next_offset, end_of_partition=False)
-                next_offset = i + 1
-                line = line.strip()
-                if not line:
-                    continue
-                msgs.append(StreamMessage(value=json.loads(line), offset=i + 1))
+                i += 1
+                next_offset = i
+                line = raw.decode("utf-8").strip()
+                if line:
+                    msgs.append(StreamMessage(value=json.loads(line), offset=i))
+            self._memo_line, self._memo_pos = i, f.tell()
         return MessageBatch(messages=msgs, next_offset=next_offset, end_of_partition=True)
 
     def latest_offset(self) -> int:

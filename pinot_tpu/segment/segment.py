@@ -118,6 +118,9 @@ class ImmutableSegment:
         self.valid_docs: Optional[np.ndarray] = None
         self.sort_order: Optional[np.ndarray] = None
         self._device_cache: Dict[str, Any] = {}
+        # durable home of this segment on local disk (set by save/load):
+        # the deep store uploads from here without a redundant re-serialize
+        self.source_dir: Optional[str] = None
 
     # ------------------------------------------------------------------
     def column(self, name: str) -> ColumnData:
@@ -232,13 +235,18 @@ class ImmutableSegment:
             else None,
         }
         store.write_segment(path, meta, regions)
+        self.source_dir = path
 
     @staticmethod
-    def load(path: str) -> "ImmutableSegment":
-        """mmap-load (ImmutableSegmentLoader.load analog — ReadMode.mmap)."""
+    def load(path: str, verify: bool = False) -> "ImmutableSegment":
+        """mmap-load (ImmutableSegmentLoader.load analog — ReadMode.mmap).
+
+        verify=True checks columns.bin against the committed size + CRC32
+        first (SegmentCorruptError on mismatch) — the deep-store download
+        and server restart-recovery paths load verified."""
         from pinot_tpu.indexes import load_index  # local import; avoids cycle
 
-        meta, regions = store.read_segment(path)
+        meta, regions = store.read_segment(path, verify=verify)
         schema = Schema.from_dict(meta["schema"])
         num_docs = meta["numDocs"]
         columns: Dict[str, ColumnData] = {}
@@ -269,7 +277,7 @@ class ImmutableSegment:
         for cname, idx in indexes.get("text", {}).items():
             if cname in columns and columns[cname].dictionary is not None:
                 idx.values = columns[cname].dictionary.values
-        return ImmutableSegment(
+        seg = ImmutableSegment(
             name=meta["segmentName"],
             table_name=meta["tableName"],
             schema=schema,
@@ -279,3 +287,5 @@ class ImmutableSegment:
             creation_time_ms=meta.get("creationTimeMs", 0),
             time_range=tuple(meta["timeRange"]) if meta.get("timeRange") else None,
         )
+        seg.source_dir = path
+        return seg

@@ -1,0 +1,561 @@
+"""Crash-safe cluster tests: durable control plane, restart recovery, and
+live rebalance — driven by the deterministic crash harness (named
+kill-points inside every commit protocol + scripted server crash/restart).
+
+The contract under test (ISSUE 8):
+  * a coordinator rebuilt over the same meta_dir has IDENTICAL ideal state,
+    segment metadata, replica-group membership, and realtime checkpoint
+    pointers;
+  * a crash at ANY named kill-point of a commit path (segment write, seal,
+    deep-store upload, checkpoint, journal append, snapshot compaction,
+    rebalance move) loses no committed rows and double-counts none;
+  * servers restarted after a crash re-download committed segments from the
+    deep store (CRC-verified) and broker routing heals;
+  * rebalance moves segments under query load without ever dropping below
+    the min-available-replicas floor.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import (
+    Broker,
+    Coordinator,
+    FaultPlan,
+    SegmentDeepStore,
+    ServerInstance,
+)
+from pinot_tpu.cluster.journal import MetaJournal
+from pinot_tpu.realtime.manager import RealtimeTableDataManager
+from pinot_tpu.realtime.stream import InMemoryStream
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.segment.store import SegmentCorruptError, verify_segment
+from pinot_tpu.spi.config import SegmentsConfig, StreamConfig, TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+from pinot_tpu.utils import crashpoints
+from pinot_tpu.utils.crashpoints import InjectedCrash
+
+from golden import assert_same_rows, sqlite_from_data
+
+
+@pytest.fixture(autouse=True)
+def _clean_kill_points():
+    crashpoints.reset()
+    yield
+    crashpoints.reset()
+
+
+def _schema():
+    return Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+
+
+def _data(n, seed, t0=1_700_000_000_000):
+    rng = np.random.default_rng(seed)
+    return {
+        "city": rng.choice(["sf", "nyc", "la"], n).astype(object),
+        "v": rng.integers(0, 100, n),
+        "ts": t0 + rng.integers(0, 86_400_000, n).astype(np.int64),
+    }
+
+
+def _durable_cluster(tmp_path, n_servers=3, replication=2, n_segments=4, rows=200):
+    """Deterministic cluster with journal + deep store: same args -> same
+    assignment, data, and on-disk layout."""
+    coord = Coordinator(
+        replication=replication,
+        meta_dir=str(tmp_path / "meta"),
+        deep_store=str(tmp_path / "deep"),
+    )
+    for i in range(n_servers):
+        coord.register_server(
+            ServerInstance(f"server{i}", data_dir=str(tmp_path / f"server{i}"))
+        )
+    coord.add_table(_schema(), TableConfig(name="t", segments=SegmentsConfig(time_column="ts")))
+    datas = []
+    for i in range(n_segments):
+        d = _data(rows, seed=100 + i)
+        datas.append(d)
+        seg = build_segment(
+            _schema(), d, f"seg{i}", output_dir=str(tmp_path / "build" / f"seg{i}")
+        )
+        coord.add_segment("t", seg)
+    merged = {k: np.concatenate([d[k] for d in datas]) for k in datas[0]}
+    return coord, merged
+
+
+QUERIES = [
+    "SELECT COUNT(*), SUM(v) FROM t",
+    "SELECT city, COUNT(*), SUM(v) FROM t GROUP BY city ORDER BY city",
+]
+
+
+def _ideal_fingerprint(coord, table="t"):
+    meta = coord.tables[table]
+    return {
+        "ideal": {seg: sorted(srvs) for seg, srvs in meta.ideal.items()},
+        "numDocs": {seg: m["numDocs"] for seg, m in meta.segment_meta.items()},
+        "timeRange": {
+            seg: tuple(m["timeRange"]) if m.get("timeRange") else None
+            for seg, m in meta.segment_meta.items()
+        },
+        "groups": dict(coord.replica_group),
+        "replication": coord.replication,
+    }
+
+
+class TestCoordinatorJournal:
+    def test_restart_rebuilds_identical_ideal_state(self, tmp_path):
+        coord, _ = _durable_cluster(tmp_path)
+        before = _ideal_fingerprint(coord)
+        coord2 = Coordinator(meta_dir=str(tmp_path / "meta"), deep_store=str(tmp_path / "deep"))
+        assert _ideal_fingerprint(coord2) == before
+        # routing view is rebuildable too once servers re-register
+        for i in range(3):
+            coord2.register_server(
+                ServerInstance(f"server{i}", data_dir=str(tmp_path / f"server{i}"))
+            )
+        assert coord2.external_view("t") == coord.external_view("t")
+
+    def test_snapshot_compaction_roundtrip(self, tmp_path):
+        coord, _ = _durable_cluster(tmp_path)
+        before = _ideal_fingerprint(coord)
+        coord.checkpoint_metadata()  # compacts: snapshot written, journal truncated
+        assert os.path.getsize(tmp_path / "meta" / "journal.jsonl") == 0
+        coord2 = Coordinator(meta_dir=str(tmp_path / "meta"))
+        assert _ideal_fingerprint(coord2) == before
+
+    def test_torn_journal_tail_is_dropped_not_fatal(self, tmp_path):
+        coord, _ = _durable_cluster(tmp_path)
+        before = _ideal_fingerprint(coord)
+        path = tmp_path / "meta" / "journal.jsonl"
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"seq": 9999, "op": "set_ideal", "table": "t", "segm')  # torn append
+        coord2 = Coordinator(meta_dir=str(tmp_path / "meta"))
+        assert _ideal_fingerprint(coord2) == before
+
+    def test_corrupt_snapshot_quarantined_and_bak_used(self, tmp_path):
+        coord, _ = _durable_cluster(tmp_path)
+        coord.checkpoint_metadata()
+        before = _ideal_fingerprint(coord)
+        # second compaction: snapshot.json.bak now holds the same state
+        coord.checkpoint_metadata()
+        snap = tmp_path / "meta" / "snapshot.json"
+        with open(snap, "w", encoding="utf-8") as f:
+            f.write("{ not json")
+        coord2 = Coordinator(meta_dir=str(tmp_path / "meta"))
+        assert _ideal_fingerprint(coord2) == before
+        assert any(p.name.startswith("snapshot.json.corrupt") for p in (tmp_path / "meta").iterdir())
+
+    @pytest.mark.parametrize(
+        "point",
+        [
+            "journal.snapshot.after_bak",
+            "journal.snapshot.after_write",
+            "journal.snapshot.before_truncate",
+        ],
+    )
+    def test_crash_mid_compaction_recovers(self, tmp_path, point):
+        """Compaction dying between ANY two steps (bak swap / snapshot
+        write / journal truncate) must leave a state the next boot rebuilds
+        exactly — idempotent replay covers the snapshot/journal overlap."""
+        coord, _ = _durable_cluster(tmp_path)
+        coord.checkpoint_metadata()  # ensure a previous snapshot exists
+        coord.add_segment(
+            "t",
+            build_segment(_schema(), _data(50, seed=999), "seg_late",
+                          output_dir=str(tmp_path / "build" / "seg_late")),
+        )
+        before = _ideal_fingerprint(coord)
+        crashpoints.arm(point)
+        with pytest.raises(InjectedCrash):
+            coord.checkpoint_metadata()
+        coord2 = Coordinator(meta_dir=str(tmp_path / "meta"))
+        assert _ideal_fingerprint(coord2) == before
+
+    @pytest.mark.parametrize(
+        "point,committed",
+        [
+            # death after upload but before the journal append: assignment
+            # never committed — the restarted coordinator must NOT know the
+            # segment (the deep-store copy is harmless orphan data)
+            ("coordinator.add_segment.after_upload", False),
+            # death after the journal append: assignment IS committed — the
+            # restarted coordinator must serve it (servers reconcile it in)
+            ("coordinator.add_segment.after_journal", True),
+        ],
+    )
+    def test_crash_mid_add_segment(self, tmp_path, point, committed):
+        coord, _ = _durable_cluster(tmp_path)
+        seg = build_segment(_schema(), _data(50, seed=999), "seg_late",
+                            output_dir=str(tmp_path / "build" / "seg_late"))
+        crashpoints.arm(point)
+        with pytest.raises(InjectedCrash):
+            coord.add_segment("t", seg)
+        coord2 = Coordinator(meta_dir=str(tmp_path / "meta"), deep_store=str(tmp_path / "deep"))
+        assert ("seg_late" in coord2.tables["t"].ideal) == committed
+        servers = [ServerInstance(f"server{i}", data_dir=str(tmp_path / f"server{i}"))
+                   for i in range(3)]
+        for s in servers:
+            coord2.register_server(s)
+        if committed:
+            # reconciliation completed the half-done placement from deep store
+            holders = [s for s in servers if s.get_segment("t", "seg_late") is not None]
+            assert sorted(s.name for s in holders) == sorted(coord2.tables["t"].ideal["seg_late"])
+        # either way the cluster serves consistent results afterwards
+        res = Broker(coord2).query("SELECT COUNT(*) FROM t")
+        expected = 4 * 200 + (50 if committed else 0)
+        assert res.rows[0][0] == expected
+
+    def test_journal_append_killpoint_loses_only_uncommitted_tail(self, tmp_path):
+        coord, _ = _durable_cluster(tmp_path)
+        before = _ideal_fingerprint(coord)
+        crashpoints.arm("journal.append.after_write")
+        with pytest.raises(InjectedCrash):
+            coord.add_table(
+                Schema("t2", [FieldSpec("x", DataType.LONG, role=FieldRole.METRIC)]),
+                TableConfig(name="t2"),
+            )
+        coord2 = Coordinator(meta_dir=str(tmp_path / "meta"))
+        # the torn append never committed; prior state intact
+        assert "t2" not in coord2.tables
+        assert _ideal_fingerprint(coord2) == before
+
+
+class TestServerCrashRestart:
+    def test_crash_then_restart_restores_from_deep_store(self, tmp_path):
+        coord, merged = _durable_cluster(tmp_path)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        conn = sqlite_from_data("t", merged)
+        baseline = {sql: broker.query(sql).rows for sql in QUERIES}
+
+        victim = coord.servers["server0"]
+        owned = set(victim.segment_names("t"))
+        assert owned, "victim must own segments for the test to bite"
+        coord.crash_server("server0")
+        assert victim.crashed and victim.segments == {}
+        # cluster still serves (replication=2) and matches golden
+        for sql in QUERIES:
+            res = broker.query(sql)
+            assert_same_rows(res.rows, baseline[sql])
+            assert_same_rows(res.rows, conn.execute(sql).fetchall())
+
+        stats = coord.restart_server("server0")
+        assert stats["restored"] == len(owned) and stats["missing"] == 0
+        assert set(victim.segment_names("t")) == owned
+        assert "server0" in coord.live
+        for sql in QUERIES:
+            assert_same_rows(broker.query(sql).rows, baseline[sql])
+
+    def test_restart_heals_broker_breaker(self, tmp_path):
+        """mark_up from restart_server resets the broker's circuit breaker
+        (the live-listener path) so the recovered server serves again."""
+        coord, _ = _durable_cluster(tmp_path, n_servers=2, replication=2)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        coord.crash_server("server0")
+        broker.query(QUERIES[0])  # routes around the dead server
+        coord.restart_server("server0")
+        res = broker.query(QUERIES[0])
+        assert res.stats.partial_result is False
+        assert broker.health.available("server0")
+
+    def test_corrupt_local_copy_heals_on_restart(self, tmp_path):
+        """A flipped byte in a server's local copy fails CRC on restart and
+        the segment re-downloads from the deep store."""
+        coord, _ = _durable_cluster(tmp_path)
+        srv = coord.servers["server0"]
+        seg_name = sorted(srv.segment_names("t"))[0]
+        coord.crash_server("server0")
+        local = os.path.join(srv.data_dir, "t", seg_name)
+        assert not os.path.isdir(local)  # lazily downloaded on first restore
+        coord.restart_server("server0")
+        assert os.path.isdir(local)
+        with open(os.path.join(local, "columns.bin"), "r+b") as f:
+            b = f.read(1)
+            f.seek(0)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(SegmentCorruptError):
+            verify_segment(local)
+        coord.crash_server("server0")
+        coord.restart_server("server0")
+        verify_segment(local)  # re-downloaded, CRC-clean
+        assert os.path.isdir(local + ".corrupt")  # evidence quarantined
+        assert srv.get_segment("t", seg_name) is not None
+
+    def test_scripted_crash_restart_mid_workload(self, tmp_path):
+        """FaultPlan lifecycle rules: server0 crashes when server1 takes its
+        2nd call, restarts on server1's 4th — queries stay exact throughout."""
+        coord, merged = _durable_cluster(tmp_path, n_servers=2, replication=2)
+        conn = sqlite_from_data("t", merged)
+        plan = (
+            FaultPlan(seed=3)
+            .crash_server("server0", on_call=2, of="server1")
+            .restart_server("server0", on_call=4, of="server1")
+            .attach(coord)
+        )
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        for round_ in range(6):
+            for sql in QUERIES:
+                assert_same_rows(broker.query(sql).rows, conn.execute(sql).fetchall())
+        kinds = [k for (_, _, k, _) in plan.log]
+        assert "crash" in kinds and "restart" in kinds
+        assert not coord.servers["server0"].crashed
+
+
+class TestSegmentCommitKillPoints:
+    SEAL_POINTS = [
+        "segment.write.after_data_write",
+        "segment.write.after_data_replace",
+        "segment.write.meta.after_write",
+        "segment.write.meta.after_replace",
+        "segment.seal.after_build",
+        "deepstore.upload.before_commit",
+        "deepstore.upload.after_commit",
+        "segment.seal.after_upload",
+        "segment.seal.after_swap",
+        "realtime.checkpoint.after_write",
+        "realtime.checkpoint.after_bak",
+        "realtime.checkpoint.after_replace",
+    ]
+
+    @pytest.mark.parametrize("point", SEAL_POINTS)
+    def test_crash_at_every_seal_step_loses_nothing(self, tmp_path, point):
+        """Kill the seal/commit protocol at EVERY named step: after restart
+        the table must hold exactly the published rows — none lost, none
+        double-counted — because the checkpoint only advances after the
+        durable build + upload, and replay re-consumes uncommitted rows."""
+        schema = _schema()
+        cfg = TableConfig(
+            name="t", stream=StreamConfig(stream_type="memory", max_rows_per_segment=16)
+        )
+        stream = InMemoryStream(num_partitions=1)
+        rows = _data(50, seed=11)
+        for i in range(50):
+            stream.publish({k: rows[k][i] for k in rows}, partition=0)
+        deep = SegmentDeepStore(str(tmp_path / "deep"))
+        mgr = RealtimeTableDataManager(
+            schema, cfg, str(tmp_path / "rt"), stream=stream, deep_store=deep
+        )
+        crashpoints.arm(point)
+        with pytest.raises(InjectedCrash):
+            mgr.consume_all()
+        assert crashpoints.fired and crashpoints.fired[-1][0] == point
+        # restart: a fresh manager over the same dirs replays the committed
+        # checkpoint and re-consumes everything after it
+        mgr2 = RealtimeTableDataManager(
+            schema, cfg, str(tmp_path / "rt"), stream=stream, deep_store=deep
+        )
+        mgr2.consume_all()
+        assert mgr2.total_rows == 50
+        v = sum(int(s.column("v").decoded().sum()) for s in mgr2.query_segments())
+        assert v == int(rows["v"].sum())
+
+    def test_checkpoint_pointer_journaled_by_coordinator(self, tmp_path):
+        schema = _schema()
+        cfg = TableConfig(
+            name="rt", stream=StreamConfig(stream_type="memory", max_rows_per_segment=16)
+        )
+        stream = InMemoryStream(num_partitions=2)
+        for i in range(60):
+            stream.publish({"city": "sf", "v": i, "ts": 1_700_000_000_000 + i}, key=f"k{i}")
+        coord = Coordinator(
+            replication=1, meta_dir=str(tmp_path / "meta"), deep_store=str(tmp_path / "deep")
+        )
+        mgr = coord.add_realtime_table(schema, cfg, str(tmp_path / "rt"), stream=stream)
+        coord.run_realtime_consumption()
+        assert mgr.total_rows == 60
+        committed = {
+            p: dict(cp) for p, cp in coord.rt_checkpoints["rt"].items()
+        }
+        assert committed, "seals must journal checkpoint pointers"
+        # a restarted coordinator knows the pointers WITHOUT the data dir,
+        # and recover_realtime resumes from them with no lost/dup rows
+        coord2 = Coordinator(meta_dir=str(tmp_path / "meta"), deep_store=str(tmp_path / "deep"))
+        assert coord2.rt_checkpoints["rt"] == committed
+        mgr2 = coord2.recover_realtime("rt", stream=stream)
+        coord2.run_realtime_consumption()
+        assert mgr2.total_rows == 60
+        # on-disk checkpoint agrees with the journaled pointers
+        with open(tmp_path / "rt" / "checkpoint.json", encoding="utf-8") as f:
+            disk = json.load(f)
+        for p, cp in committed.items():
+            assert disk[str(p)]["offset"] == cp["offset"]
+            assert disk[str(p)]["seq"] == cp["seq"]
+
+
+class TestLiveRebalance:
+    def test_rebalance_load_before_drop_under_queries(self, tmp_path):
+        """A new server joins; rebalance moves segments onto it while
+        queries run at EVERY protocol step — the availability floor holds
+        (every segment keeps >= min live replicas at add/commit/drop), and
+        every interleaved query is exact."""
+        coord, merged = _durable_cluster(tmp_path, n_servers=2, replication=2, n_segments=6)
+        conn = sqlite_from_data("t", merged)
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        baseline = {sql: conn.execute(sql).fetchall() for sql in QUERIES}
+        new_server = ServerInstance("server_new", data_dir=str(tmp_path / "server_new"))
+        coord.register_server(new_server)
+
+        floors = []
+
+        def probe(point):
+            # runs at every rebalance kill-point: queries must stay exact
+            # and no segment may drop below the floor, mid-move included
+            for sql in QUERIES:
+                assert_same_rows(broker.query(sql).rows, baseline[sql])
+            view = coord.external_view("t")
+            floors.append(min(len(v) for v in view.values()))
+
+        import pinot_tpu.cluster.rebalance as rebalance_mod
+
+        orig = rebalance_mod.crash_point
+        rebalance_mod.crash_point = probe
+        try:
+            stats = coord.rebalance("t", min_available_replicas=1)
+        finally:
+            rebalance_mod.crash_point = orig
+        assert stats["segmentsMoved"] > 0
+        assert floors and min(floors) >= 1
+        # moves landed on the new server and results still exact
+        assert new_server.segment_names("t")
+        for sql in QUERIES:
+            assert_same_rows(broker.query(sql).rows, baseline[sql])
+        # versioned view: the rebalance committed new routing epochs
+        v1, view = coord.versioned_view("t")
+        assert v1 > 0 and all(view.values())
+
+    @pytest.mark.parametrize("point", ["rebalance.after_add", "rebalance.after_commit"])
+    def test_crash_mid_rebalance_converges_on_restart(self, tmp_path, point):
+        coord, merged = _durable_cluster(tmp_path, n_servers=2, replication=2, n_segments=6)
+        conn = sqlite_from_data("t", merged)
+        coord.register_server(
+            ServerInstance("server_new", data_dir=str(tmp_path / "server_new"))
+        )
+        crashpoints.arm(point)
+        with pytest.raises(InjectedCrash):
+            coord.rebalance("t")
+        # coordinator restarts from its journal; servers re-register and
+        # reconcile — stale copies drop, committed moves complete
+        coord2 = Coordinator(meta_dir=str(tmp_path / "meta"), deep_store=str(tmp_path / "deep"))
+        servers = [
+            ServerInstance(n, data_dir=str(tmp_path / n))
+            for n in ("server0", "server1", "server_new")
+        ]
+        for s in servers:
+            coord2.register_server(s)
+        # every ideal assignment is actually served
+        for seg, assigned in coord2.tables["t"].ideal.items():
+            for name in assigned:
+                assert coord2.servers[name].get_segment("t", seg) is not None
+        broker = Broker(coord2)
+        broker._sleep = lambda s: None
+        for sql in QUERIES:
+            assert_same_rows(broker.query(sql).rows, conn.execute(sql).fetchall())
+        # finishing the rebalance converges (idempotent)
+        coord2.rebalance("t")
+        for sql in QUERIES:
+            assert_same_rows(broker.query(sql).rows, conn.execute(sql).fetchall())
+
+
+class TestLifecycleChaosAcceptance:
+    def test_lifecycle_chaos_end_to_end(self, tmp_path):
+        """ISSUE 8 acceptance: seeded FaultPlan crashes/restarts servers
+        mid-scatter, the coordinator itself dies mid-assignment (kill-point)
+        and restarts from its journal, and a rebalance runs between query
+        rounds — every query either succeeds with results identical to the
+        fault-free baseline or returns a structured partial/error response;
+        after all restarts the ideal state, total rows, and stream offsets
+        match the pre-crash committed state; the availability floor holds."""
+        # fault-free baseline over identical data
+        baseline_coord, merged = _durable_cluster(
+            tmp_path / "base", n_servers=3, replication=2, n_segments=5
+        )
+        conn = sqlite_from_data("t", merged)
+        baseline = {sql: Broker(baseline_coord).query(sql).rows for sql in QUERIES}
+        for sql in QUERIES:
+            assert_same_rows(baseline[sql], conn.execute(sql).fetchall())
+
+        # chaos cluster: same data, lifecycle fault plan attached
+        coord, _ = _durable_cluster(tmp_path / "chaos", n_servers=3, replication=2, n_segments=5)
+        plan = (
+            FaultPlan(seed=42)
+            .crash_server("server0", on_call=2, of="server1")
+            .restart_server("server0", on_call=5, of="server1")
+            .crash_server("server2", on_call=7, of="server1")
+            .restart_server("server2", on_call=9, of="server1")
+            .attach(coord)
+        )
+        broker = Broker(coord)
+        broker._sleep = lambda s: None
+        total_sql = "SET allowPartialResults = true; SELECT COUNT(*), SUM(v) FROM t"
+
+        ok = partial = 0
+        for round_ in range(8):
+            for sql in QUERIES:
+                res = broker.query("SET allowPartialResults = true; " + sql)
+                if res.stats.partial_result:
+                    # structured degradation: exceptions recorded, not wrong rows
+                    partial += 1
+                    assert res.stats.exceptions
+                else:
+                    ok += 1
+                    assert_same_rows(res.rows, baseline[sql])
+            # floor invariant after every round: with both crash targets
+            # never down at once, every segment keeps >= 1 live replica
+            view = coord.external_view("t")
+            assert min(len(v) for v in view.values()) >= 1
+        assert ok > 0
+        kinds = [k for (_, _, k, _) in plan.log]
+        assert kinds.count("crash") == 2 and kinds.count("restart") == 2
+
+        # rebalance under the recovered topology, then exactness again
+        coord.rebalance("t")
+        for sql in QUERIES:
+            assert_same_rows(broker.query(sql).rows, baseline[sql])
+
+        # --- coordinator crash mid-assignment, restart from journal -------
+        seg = build_segment(
+            _schema(), _data(80, seed=777), "seg_chaos",
+            output_dir=str(tmp_path / "chaos" / "build" / "seg_chaos"),
+        )
+        pre_crash = _ideal_fingerprint(coord)
+        crashpoints.arm("coordinator.add_segment.after_journal")
+        with pytest.raises(InjectedCrash):
+            coord.add_segment("t", seg)
+
+        coord2 = Coordinator(
+            meta_dir=str(tmp_path / "chaos" / "meta"),
+            deep_store=str(tmp_path / "chaos" / "deep"),
+        )
+        # identical committed control-plane state: everything from before the
+        # crash, plus the journaled (committed) assignment of seg_chaos
+        restored = _ideal_fingerprint(coord2)
+        assert "seg_chaos" in coord2.tables["t"].ideal
+        assert restored["numDocs"].pop("seg_chaos") == 80
+        for key in ("ideal", "timeRange"):
+            restored[key].pop("seg_chaos")
+        assert restored == pre_crash
+        for i in range(3):
+            coord2.register_server(
+                ServerInstance(f"server{i}", data_dir=str(tmp_path / "chaos" / f"server{i}"))
+            )
+        broker2 = Broker(coord2)
+        broker2._sleep = lambda s: None
+        res = broker2.query("SELECT COUNT(*), SUM(v) FROM t")
+        assert res.rows[0][0] == 5 * 200 + 80  # committed rows, exactly once
+        assert res.stats.partial_result is False
+        # floor invariant on the rebuilt cluster
+        view = coord2.external_view("t")
+        assert min(len(v) for v in view.values()) >= 1

@@ -511,6 +511,82 @@ class TestW015UnboundedServingGrowth:
         assert _rules(src, threaded=True) == ["W015"]
 
 
+class TestW016DurableWriteDiscipline:
+    def test_flags_in_place_write_to_checkpoint_path(self):
+        src = """
+        import json
+
+        def save(state, path):
+            with open(path + "/checkpoint.json", "w") as f:
+                json.dump(state, f)
+        """
+        assert _rules(src) == ["W016"]
+
+    def test_flags_bare_write_in_commit_function(self):
+        src = """
+        import json
+
+        def commit_state(state, path):
+            with open(path, "w") as f:
+                json.dump(state, f)
+        """
+        assert _rules(src) == ["W016"]
+
+    def test_flags_binary_manifest_write(self):
+        src = """
+        def dump(blob, d):
+            with open(d + "/manifest.bin", "wb") as f:
+                f.write(blob)
+        """
+        assert _rules(src) == ["W016"]
+
+    def test_quiet_with_tmp_fsync_replace_discipline(self):
+        src = """
+        import json, os
+
+        def commit_checkpoint(state, path):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_with_durable_write_helper(self):
+        src = """
+        from pinot_tpu.spi.filesystem import durable_write_json
+
+        def commit_checkpoint(state, path):
+            durable_write_json(path, state)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_non_durable_paths_and_reads(self):
+        src = """
+        import json
+
+        def export_report(rows, path):
+            with open(path + "/report.csv", "w") as f:
+                f.write(rows)
+
+        def load_checkpoint(path):
+            with open(path + "/checkpoint.json") as f:
+                return json.load(f)
+        """
+        assert _rules(src) == []
+
+    def test_runs_unthreaded_everywhere(self):
+        src = """
+        def write_journal(entries, path):
+            with open(path, "w") as f:
+                f.writelines(entries)
+        """
+        assert _rules(src, threaded=False) == ["W016"]
+        assert _rules(src, threaded=True) == ["W016"]
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

@@ -391,47 +391,104 @@ def main() -> None:
             bytes_per_row += 1
     bytes_per_row += len(plan.row_sharded_params) * 4 / 32
 
-    print(
-        json.dumps(
-            {
-                "metric": "ssb_groupby_rows_scanned_per_sec",
-                "value": round(rows_per_sec, 1),
-                "unit": "rows/sec",
-                "vs_baseline": round(rows_per_sec / JAVA_SERVER_ROWS_PER_SEC, 3),
-                "value_marginal": round(marg, 1),
-                "value_amortized_floor": round(amortized, 1),
-                "run_variance": round(spread, 4),
-                "timing_pairs": [[round(a, 4), round(b, 4)] for a, b in pairs],
-                "invalid_pairs": n_invalid,
-                "remeasure_rounds": remeasured,
-                "value_e2e": round(n / e2e, 1),
-                "e2e_seconds": round(e2e, 4),
-                "latency_ms": {
-                    "count": lat["count"],
-                    "p50": round(lat["p50Ms"], 3),
-                    "p95": round(lat["p95Ms"], 3),
-                    "p99": round(lat["p99Ms"], 3),
-                    "mean": round(lat["meanMs"], 3),
-                    "max": round(lat["maxMs"], 3),
-                },
-                "trace_stage_ms": stage_ms,
-                "distinct_literal_sweep": sweep,
-                "plan_cache": {
-                    "hits": plan_cache["hits"],
-                    "cold_compiles": plan_cache["cold_compiles"],
-                    "warm_recompiles": plan_cache["warm_recompiles"],
-                    "hit_rate": round(plan_cache["hit_rate"], 3),
-                },
-                "rows": n,
-                "filter_index_uses": index_uses,
-                "cpu_proxy_rows_per_sec": round(_cpu_proxy(), 1),
-                "baseline_denominator": JAVA_SERVER_ROWS_PER_SEC,
-                "backend": ops.scan_backend(),
-                "effective_bytes_per_sec": round(rows_per_sec * bytes_per_row, 1),
-                "overload": _overload_bench(),
-            }
-        )
+    # ---- roofline reconciliation (observatory r6) ---------------------
+    # Two byte models for the same kernel: the analytic packed-storage
+    # estimate above vs XLA's own cost_analysis() on the lowered plan
+    # (force="xla" — on CPU the serving path skips the extra lowering, but
+    # the bench pays it once to reconcile the models).  Achieved bytes/s
+    # under each model divides into the device peak for roofline %.
+    from pinot_tpu.utils import perf
+
+    batch_rows = getattr(plan, "batch_docs", 0) or n
+    xla_cost = perf.capture_cost(
+        plan.fn,
+        batches[0],
+        perf.analytic_cost(
+            batch_rows,
+            bytes_per_row,
+            kind=plan.kind,
+            num_groups=plan.num_groups,
+            num_entries=len(plan.aggs),
+        ),
+        force="xla",
     )
+    cost_bpr = xla_cost.bytes_accessed / batch_rows if xla_cost.source == "xla" else None
+    used_bpr = cost_bpr if cost_bpr is not None else bytes_per_row
+    peak_bps = perf.peak_hbm_bytes_per_sec()
+    try:
+        device_kind = jax.devices()[0].device_kind
+    except Exception:
+        device_kind = "unknown"
+    roofline = {
+        "device_kind": device_kind,
+        "peak_hbm_bytes_per_sec": peak_bps,
+        "source": xla_cost.source,  # "xla" when cost_analysis answered, else "analytic"
+        "analytic_bytes_per_row": round(bytes_per_row, 3),
+        "cost_analysis_bytes_per_row": round(cost_bpr, 3) if cost_bpr is not None else None,
+        # >1 means XLA sees more traffic than the packed-storage model
+        # (widening copies, bitmap word reads); the gap is the reconciliation
+        "bytes_model_ratio": round(cost_bpr / bytes_per_row, 3) if cost_bpr and bytes_per_row else None,
+        "cost_bytes_per_sec": round(rows_per_sec * used_bpr, 1),
+        # per-section achieved-vs-peak %: marginal kernel, e2e, warm sweep
+        "kernel_roofline_pct": round(100.0 * rows_per_sec * used_bpr / peak_bps, 3),
+        "e2e_roofline_pct": round(100.0 * (n / e2e) * used_bpr / peak_bps, 3),
+        "warm_p50_roofline_pct": round(
+            100.0 * sweep["warm_p50_rows_per_sec"] * used_bpr / peak_bps, 3
+        ),
+    }
+
+    report = {
+        "metric": "ssb_groupby_rows_scanned_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(rows_per_sec / JAVA_SERVER_ROWS_PER_SEC, 3),
+        "value_marginal": round(marg, 1),
+        "value_amortized_floor": round(amortized, 1),
+        "run_variance": round(spread, 4),
+        "timing_pairs": [[round(a, 4), round(b, 4)] for a, b in pairs],
+        "invalid_pairs": n_invalid,
+        "remeasure_rounds": remeasured,
+        "value_e2e": round(n / e2e, 1),
+        "e2e_seconds": round(e2e, 4),
+        "latency_ms": {
+            "count": lat["count"],
+            "p50": round(lat["p50Ms"], 3),
+            "p95": round(lat["p95Ms"], 3),
+            "p99": round(lat["p99Ms"], 3),
+            "mean": round(lat["meanMs"], 3),
+            "max": round(lat["maxMs"], 3),
+        },
+        "trace_stage_ms": stage_ms,
+        "distinct_literal_sweep": sweep,
+        "plan_cache": {
+            "hits": plan_cache["hits"],
+            "cold_compiles": plan_cache["cold_compiles"],
+            "warm_recompiles": plan_cache["warm_recompiles"],
+            "hit_rate": round(plan_cache["hit_rate"], 3),
+        },
+        "rows": n,
+        "filter_index_uses": index_uses,
+        "cpu_proxy_rows_per_sec": round(_cpu_proxy(), 1),
+        "baseline_denominator": JAVA_SERVER_ROWS_PER_SEC,
+        "backend": ops.scan_backend(),
+        "effective_bytes_per_sec": round(rows_per_sec * bytes_per_row, 1),
+        "roofline": roofline,
+        "overload": _overload_bench(),
+    }
+    print(json.dumps(report))
+
+    # ---- bench history (regression gate input) ------------------------
+    # One flat line per run; `cli perf --check` compares the newest line
+    # against the pinned BENCH_BASELINE.json.  PINOT_TPU_BENCH_HISTORY=0
+    # disables; any other value overrides the path.
+    history = os.environ.get(
+        "PINOT_TPU_BENCH_HISTORY",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_history.jsonl"),
+    )
+    if history != "0":
+        rec = perf.bench_record(report)
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        perf.append_bench_history(history, rec)
 
 
 if __name__ == "__main__":

@@ -69,6 +69,19 @@ been bitten by (ADVICE r5) or that silently degrades TPU throughput:
                               A crash mid-write then tears the committed
                               file — exactly the corruption class the
                               recovery paths quarantine.
+  W017 unfenced-timing        wall-clock timing (`t0 = time.perf_counter()`
+                              ... `dt = ... - t0`) brackets a call to a
+                              jitted callable (a name assigned from
+                              `jax.jit(...)` or decorated with @jit) with
+                              no device fence (`block_until_ready` /
+                              `device_get`) before the stop timestamp.
+                              JAX dispatch is async — the subtraction then
+                              times the enqueue, not the compute, and the
+                              "measurement" silently reports dispatch
+                              latency as kernel throughput.  Attribute
+                              calls (`plan.fn(...)`) are out of scope:
+                              engine code deliberately times dispatch cost
+                              there (compile_ms capture).
 
 Kernel bodies (W001/W002 scope) are functions the module jits: decorated
 with @jax.jit / @partial(jax.jit, ...) or passed by name to jax.jit(...)
@@ -101,6 +114,7 @@ RULES: Dict[str, str] = {
     "W008": "literal-baked fingerprint() used as a plan-cache key (use shape_fingerprint)",
     "W015": "unbounded container growth on a cluster serving path (no bound/eviction)",
     "W016": "non-durable write to a durability path (no tmp-fsync-replace discipline)",
+    "W017": "wall-clock timing around an async jitted dispatch without a device fence before the stop timestamp",
     # interprocedural passes (analysis/races.py, analysis/device_sync.py —
     # run via analysis/engine.py over the whole package, not per-file):
     "W010": "lock-guarded attribute read/written without holding its lock",
@@ -899,6 +913,126 @@ def _check_w016(path: str, tree: ast.AST, findings: List[Finding]) -> None:
             scan_scope(node.name, node.body)
 
 
+_W017_CLOCK_FUNCS = frozenset({"perf_counter", "monotonic"})
+_W017_FENCE_FUNCS = frozenset({"block_until_ready", "device_get"})
+
+
+def _is_perf_clock_call(node: ast.AST) -> bool:
+    """Call to time.perf_counter / time.monotonic (module attr or bare)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (fn.id if isinstance(fn, ast.Name) else None)
+    return name in _W017_CLOCK_FUNCS
+
+
+def _w017_dispatch_names(tree: ast.AST) -> Set[str]:
+    """Names that ARE jitted callables when called: `f = jax.jit(...)`
+    assignment targets and @jit-decorated function names.  (Distinct from
+    _jitted_function_names, which collects the UNDERLYING function passed
+    to jit — calling that name directly runs eagerly and times fine.)"""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+            and _is_jit_func(node.value.func)
+        ):
+            out.add(node.targets[0].id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and _has_jit_decorator(node):
+            out.add(node.name)
+    return out
+
+
+def _check_w017(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """Unfenced wall-clock timing of an async dispatch: between a
+    perf_counter/monotonic timer start and the subtraction that stops it,
+    a jitted callable is invoked by name with no block_until_ready /
+    device_get before the stop.  The elapsed time then measures enqueue
+    latency, not device compute — the bench-number class of bug.
+
+    Deliberately narrow to keep the package lint-clean where timing
+    dispatch IS the point: only bare-Name calls to known-jitted names
+    count as dispatches (engine code calling `plan.fn(...)` to measure
+    compile/dispatch cost is an attribute call and out of scope), and a
+    fence anywhere between the dispatch and the stop — including wrapping
+    the dispatch itself, `device_get(f(x))` — clears it."""
+    dispatch_names = _w017_dispatch_names(tree)
+    if not dispatch_names:
+        return
+
+    def scope_nodes(body: List[ast.stmt]) -> List[ast.AST]:
+        nodes: List[ast.AST] = []
+        stack: List[ast.AST] = list(body)
+        while stack:
+            n = stack.pop()
+            nodes.append(n)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # nested scope: its own timers, its own pass
+            stack.extend(ast.iter_child_nodes(n))
+        return nodes
+
+    def scan_scope(body: List[ast.stmt]) -> None:
+        nodes = scope_nodes(body)
+        starts: List[tuple] = []  # (lineno, timer name)
+        timer_names: Set[str] = set()
+        dispatches: List[int] = []
+        fences: List[int] = []
+        for n in nodes:
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and _is_perf_clock_call(n.value)
+            ):
+                starts.append((n.lineno, n.targets[0].id))
+                timer_names.add(n.targets[0].id)
+            elif isinstance(n, ast.Call):
+                fn = n.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None
+                )
+                if name in _W017_FENCE_FUNCS:
+                    fences.append(n.lineno)
+                elif isinstance(fn, ast.Name) and fn.id in dispatch_names:
+                    dispatches.append(n.lineno)
+        if not timer_names or not dispatches:
+            return
+        for n in nodes:
+            if not (isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub)):
+                continue
+            used = {
+                x.id for x in ast.walk(n) if isinstance(x, ast.Name) and x.id in timer_names
+            }
+            for tname in used:
+                begins = [ln for ln, name in starts if name == tname and ln <= n.lineno]
+                if not begins:
+                    continue
+                begin = max(begins)
+                between = [d for d in dispatches if begin < d <= n.lineno]
+                if not between:
+                    continue
+                last_dispatch = max(between)
+                if any(last_dispatch <= f <= n.lineno for f in fences):
+                    continue
+                findings.append(
+                    Finding(
+                        path, n.lineno, "W017",
+                        f"elapsed-time stop for timer '{tname}' after a jitted dispatch "
+                        f"(line {last_dispatch}) with no block_until_ready/device_get fence — "
+                        f"async dispatch means this times the enqueue, not the compute",
+                    )
+                )
+                break  # one finding per stop expression
+
+    scan_scope(getattr(tree, "body", []))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_scope(node.body)
+
+
 _SUPPRESS_MARK = "pinot-lint:"
 
 
@@ -960,6 +1094,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
     _check_w007(path, tree, findings)
     _check_w008(path, tree, findings)
     _check_w016(path, tree, findings)
+    _check_w017(path, tree, findings)
     if threaded:
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)

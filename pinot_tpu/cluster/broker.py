@@ -599,6 +599,7 @@ class Broker:
                     stats.num_docs_scanned += sstats.num_docs_scanned
                     rt_docs += sstats.num_docs_scanned
                     stats.add_index_uses(sstats.filter_index_uses)
+                    stats.add_kernel_cost(sstats)
                     results.append(res)
                 if rsp is not None:
                     rsp.annotate(docs=rt_docs)
@@ -618,6 +619,19 @@ class Broker:
 
                 self.result_cache.put(ckey, copy.deepcopy(out))
         METRICS.histogram("broker.queryLatency").update(out.stats.time_ms)
+        from pinot_tpu.query.shape import shape_digest
+        from pinot_tpu.utils import perf
+
+        perf.PERF_LEDGER.record(
+            table,
+            shape_digest(ctx.shape_fingerprint()),
+            rows=out.stats.num_docs_scanned,
+            time_ms=out.stats.time_ms,
+            kernel_bytes=out.stats.kernel_bytes,
+            compile_ms=out.stats.compile_ms,
+            cache_hit=out.stats.compile_ms == 0.0,
+            engine="broker",
+        )
         return out
 
     # -- fault-tolerant scatter-gather ------------------------------------
@@ -792,6 +806,7 @@ class Broker:
                             stats.num_docs_scanned += sstats.num_docs_scanned
                             stats.total_docs += sstats.total_docs
                             stats.add_index_uses(sstats.filter_index_uses)
+                            stats.add_kernel_cost(sstats)
                             trace.graft(sstats.trace)
                             if ssp is not None:
                                 ssp.annotate(docs=sstats.num_docs_scanned)
@@ -853,6 +868,48 @@ class Broker:
         stats.partial_result = True
         stats.exceptions.append({"errorCode": "NO_REPLICA_AVAILABLE", "message": msg})
         METRICS.counter("broker.partialResults").inc()
+
+    # -- cluster metric federation (tentpole r9c) -------------------------
+    def federated_registries(self):
+        """name -> per-server MetricsRegistry for every registered server —
+        the scrape set the broker federates (BrokerMetrics pulling
+        ServerMetrics; here a method call instead of an HTTP scrape)."""
+        return {
+            name: srv.metrics
+            for name, srv in self.coordinator.servers.items()
+            if getattr(srv, "metrics", None) is not None
+        }
+
+    def federated_prometheus(self) -> str:
+        """Cluster-wide Prometheus exposition: this broker process's own
+        registry (unlabeled, as before) plus every server's registry as
+        `{server="..."}`-labeled series and `pinot_cluster_*` merged
+        aggregates — `GET /metrics?format=prometheus` describes the
+        cluster, not one process."""
+        from pinot_tpu.utils.metrics import federate_prometheus
+
+        return METRICS.to_prometheus() + federate_prometheus(self.federated_registries())
+
+    def federated_snapshot(self):
+        """JSON twin of federated_prometheus: per-server snapshots plus the
+        merged cluster view (sum/max/last semantics per metric type)."""
+        from pinot_tpu.utils.metrics import merge_registry_snapshots
+
+        regs = self.federated_registries()
+        return {
+            "perServer": {name: reg.snapshot() for name, reg in regs.items()},
+            "cluster": merge_registry_snapshots(regs),
+        }
+
+    def perf_snapshot(self):
+        """Per-table/per-shape perf ledger view (GET /debug/perf), plus the
+        live named-cache occupancy (plan caches, result cache)."""
+        from pinot_tpu.utils.cache import named_cache_stats
+        from pinot_tpu.utils.perf import PERF_LEDGER
+
+        snap = PERF_LEDGER.snapshot()
+        snap["caches"] = named_cache_stats()
+        return snap
 
     def _explain(self, ctx: QueryContext) -> ResultTable:
         """EXPLAIN PLAN FOR through the broker: reuse the engine explain

@@ -96,14 +96,22 @@ class QueryServer:
                     if url.path == "/health":
                         self._send(200, {"status": "OK"})
                     elif url.path == "/metrics":
+                        # a broker engine federates its servers' registries
+                        # into one labeled cluster exposition; plain engines
+                        # fall back to this process's registry
+                        fed = getattr(outer.engine, "federated_prometheus", None)
                         if qs.get("format", [""])[0] == "prometheus":
                             self._send_text(
                                 200,
-                                METRICS.to_prometheus(),
+                                fed() if fed is not None else METRICS.to_prometheus(),
                                 "text/plain; version=0.0.4; charset=utf-8",
                             )
                         else:
-                            self._send(200, METRICS.snapshot())
+                            snap = METRICS.snapshot()
+                            fed_json = getattr(outer.engine, "federated_snapshot", None)
+                            if fed_json is not None:
+                                snap["servers"] = fed_json()
+                            self._send(200, snap)
                     elif url.path == "/debug/queries":
                         slow = getattr(outer.engine, "slow_queries", None)
                         if slow is None:
@@ -117,6 +125,17 @@ class QueryServer:
                             self._send(404, {"error": "engine has no resource governor"})
                             return
                         self._send(200, gov.snapshot())
+                    elif url.path == "/debug/perf":
+                        # per-table/per-shape perf ledger (utils/perf.py):
+                        # rolling rows/s, bytes/s, roofline %, compile ms,
+                        # plan-cache outcomes, QPS — the `cli perf` source
+                        snap_fn = getattr(outer.engine, "perf_snapshot", None)
+                        if snap_fn is not None:
+                            self._send(200, snap_fn())
+                        else:
+                            from pinot_tpu.utils.perf import PERF_LEDGER
+
+                            self._send(200, PERF_LEDGER.snapshot())
                     elif url.path.startswith("/cursors/"):
                         parts = url.path.strip("/").split("/")
                         cid = parts[1]

@@ -29,7 +29,8 @@ from pinot_tpu.query.ir import QueryContext
 from pinot_tpu.query.result import ExecutionStats
 from pinot_tpu.query.safety import Deadline, QueryTimeoutError, estimate_segment_bytes
 from pinot_tpu.segment.segment import ImmutableSegment
-from pinot_tpu.utils.metrics import METRICS
+from pinot_tpu.utils import perf
+from pinot_tpu.utils.metrics import METRICS, MetricsRegistry
 
 
 def _segment_bytes(segment: ImmutableSegment) -> int:
@@ -62,6 +63,11 @@ class ServerInstance:
         # execute fails like a dead TCP peer until the coordinator restarts
         # and reconciles this server
         self.crashed = False
+        # per-SERVER metric registry (ServerMetrics analog): the broker
+        # federates these into one labeled cluster exposition
+        # (utils.metrics.federate_prometheus) — the process-global METRICS
+        # keeps its role as this process's aggregate view
+        self.metrics = MetricsRegistry()
 
     # -- crash / restart (process-death simulation) -----------------------
     def crash(self) -> None:
@@ -98,11 +104,13 @@ class ServerInstance:
         # device-residency gauge: segment host arrays mirror what the
         # executor's pytree cache pins in HBM for this table
         METRICS.gauge(f"server.segmentBytes.{table}").add(_segment_bytes(segment))
+        self.metrics.gauge(f"server.segmentBytes.{table}").add(_segment_bytes(segment))
 
     def drop_segment(self, table: str, seg_name: str) -> None:
         seg = self.segments.get(table, {}).pop(seg_name, None)
         if seg is not None:
             METRICS.gauge(f"server.segmentBytes.{table}").add(-_segment_bytes(seg))
+            self.metrics.gauge(f"server.segmentBytes.{table}").add(-_segment_bytes(seg))
 
     def get_segment(self, table: str, seg_name: str) -> Optional[ImmutableSegment]:
         return self.segments.get(table, {}).get(seg_name)
@@ -184,8 +192,16 @@ class ServerInstance:
                         stats.num_segments_pruned += 1
                         continue
                     # pipelined: dispatch all kernels async, then drain (executor.py)
-                    with trace.span(f"launch:{seg.name}"):
-                        pending.append(executor.launch_segment(ctx, seg, device=self.device))
+                    with trace.span(f"launch:{seg.name}") as lsp:
+                        st = executor.launch_segment(ctx, seg, device=self.device)
+                        pending.append(st)
+                    if lsp is not None and st[0] == "pending":
+                        # per-operator cost model for EXPLAIN ANALYZE / traces
+                        lsp.annotate(
+                            kernelBytes=st[5].kernel_bytes,
+                            kernelFlops=st[5].kernel_flops,
+                            costSource=st[5].kernel_cost_source,
+                        )
                 if dsp is not None:
                     dsp.annotate(launches=len(pending))
             if trace.enabled:
@@ -193,9 +209,22 @@ class ServerInstance:
                 # (trace-only — the untraced path lets collect's device_get be
                 # the fence so cancellation stays responsive between collects)
                 import jax
+                import time as _time
 
-                with trace.span("device_wait", launches=len(pending)):
+                pend_bytes = sum(
+                    s[5].kernel_bytes for s in pending if s[0] == "pending"
+                )
+                tw = _time.perf_counter()
+                with trace.span("device_wait", launches=len(pending)) as wsp:
                     jax.block_until_ready(executor.pending_outputs(pending))
+                wait_s = _time.perf_counter() - tw
+                stats.device_ms = wait_s * 1000.0
+                if wsp is not None:
+                    roof = perf.roofline_pct(pend_bytes, wait_s)
+                    wsp.annotate(
+                        kernelBytes=pend_bytes,
+                        **({"rooflinePct": round(roof, 2)} if roof is not None else {}),
+                    )
             for i, st in enumerate(pending):
                 self._check_budget(deadline, cancelled=len(pending) - i, cancel=cancel)
                 with trace.span("collect") as csp:
@@ -205,7 +234,14 @@ class ServerInstance:
                 stats.num_segments_processed += 1
                 stats.num_docs_scanned += seg_stats.num_docs_scanned
                 stats.add_index_uses(seg_stats.filter_index_uses)
+                stats.add_kernel_cost(seg_stats)
                 results.append(res)
+            # server-local series the broker federates into the cluster view
+            self.metrics.counter("server.queries").inc()
+            self.metrics.counter("server.docsScanned").inc(stats.num_docs_scanned)
+            self.metrics.counter("server.kernelBytes").inc(int(stats.kernel_bytes))
+            if stats.compile_ms > 0:
+                self.metrics.timer("server.compileMs").update(stats.compile_ms)
             if trace.enabled:
                 from pinot_tpu import ops
 

@@ -157,6 +157,9 @@ class _MsePlan:
     select_columns: List[str] = None
     joins_info: List[Tuple[str, str]] = None
     dup_idx: Optional[int] = None
+    # kernel cost model (utils/perf.KernelCost), captured at first dispatch
+    # and shared through the plan cache (hits copy it forward)
+    cost: Optional[Any] = None
 
 
 class MultiStageEngine:
@@ -234,6 +237,19 @@ class MultiStageEngine:
         result = self._run(rq.ctx, plan, fact_cols, fact_valid, dim_cols, dim_valids, params, stats)
         out = reduce_mod.reduce_results(rq.ctx, [result], stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
+        from pinot_tpu.query.shape import shape_digest
+        from pinot_tpu.utils import perf
+
+        perf.PERF_LEDGER.record(
+            rq.fact,
+            shape_digest(getattr(self, "_last_shape_fp", "")),
+            rows=out.stats.num_docs_scanned,
+            time_ms=out.stats.time_ms,
+            kernel_bytes=out.stats.kernel_bytes,
+            compile_ms=out.stats.compile_ms,
+            cache_hit=getattr(self, "_last_plan_cache_hit", None),
+            engine="mse",
+        )
         return out
 
     # ------------------------------------------------------------------
@@ -269,9 +285,16 @@ class MultiStageEngine:
                 params_structure(plan.params) == params_structure(cached.params)
                 and plan.sharded_by_ns == cached.sharded_by_ns
             ):
+                # cost model rides the cache entry (captured once at the
+                # cached plan's first dispatch, never re-lowered on hits)
+                plan.cost = cached.cost
                 MSE_AUDIT.record_hit(key[0])
+                self._last_plan_cache_hit = True
+                self._last_shape_fp = key[0]
                 return plan
         MSE_AUDIT.record_compile(key[0])
+        self._last_plan_cache_hit = False
+        self._last_shape_fp = key[0]
         plan = self._build_plan(rq, strategy)
         self._plan_cache.put(key, plan)
         return plan
@@ -922,7 +945,35 @@ class MultiStageEngine:
 
     # ------------------------------------------------------------------
     def _run(self, ctx, plan: _MsePlan, fact_cols, fact_valid, dim_cols, dim_valids, params, stats):
+        from pinot_tpu.utils import perf
+
+        first_dispatch = plan.cost is None
+        if first_dispatch:
+            # fact-side scan dominates the byte traffic; dim tables are
+            # broadcast-small by strategy, so the analytic model reads the
+            # fact columns only (the XLA source covers everything)
+            fact_st = self.tables[plan.rq.fact]
+            plan.cost = perf.capture_cost(
+                plan.fn,
+                (fact_cols, fact_valid, dim_cols, dim_valids, params),
+                perf.analytic_cost(
+                    fact_st.num_docs,
+                    perf.analytic_bytes_per_row(
+                        fact_st.column(n) for n in plan.fact_needed
+                    ),
+                    kind=plan.kind,
+                    num_groups=plan.num_groups,
+                    num_entries=len(plan.aggs) if plan.aggs else 1,
+                ),
+            )
+        td0 = time.perf_counter()
         out, overflow = plan.fn(fact_cols, fact_valid, dim_cols, dim_valids, params)
+        if first_dispatch:
+            plan.cost.compile_ms = (time.perf_counter() - td0) * 1000.0
+            stats.compile_ms += plan.cost.compile_ms + plan.cost.lower_ms
+        stats.kernel_bytes += plan.cost.bytes_accessed
+        stats.kernel_flops += plan.cost.flops
+        stats.kernel_cost_source = plan.cost.source
         overflow = int(jax.device_get(overflow))
         if overflow:
             raise RuntimeError(

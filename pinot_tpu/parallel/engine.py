@@ -17,6 +17,7 @@ DataTable/Netty have no analog here by design: the wire format between
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from types import SimpleNamespace
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -44,6 +45,7 @@ from pinot_tpu.query.result import (
     SelectionSegmentResult,
 )
 from pinot_tpu.query.transform import as_row_array, eval_expr
+from pinot_tpu.utils import perf
 from pinot_tpu.utils.metrics import METRICS
 
 
@@ -184,6 +186,9 @@ class _DistPlan:
     # jitted device-side cross-launch merge for the sparse group-by path
     # (ops.merge_sparse_tables); None falls back to the host numpy merge
     sparse_merge_fn: Optional[Callable] = None
+    # per-LAUNCH kernel cost model (utils/perf.KernelCost), captured at the
+    # first dispatch and shared through the plan cache (hits copy it)
+    cost: Optional[Any] = None
 
 
 class DistributedEngine:
@@ -313,6 +318,18 @@ class DistributedEngine:
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         METRICS.counter("dist.queries").inc()
         METRICS.histogram("dist.queryLatency").update(out.stats.time_ms)
+        from pinot_tpu.query.shape import shape_digest
+
+        perf.PERF_LEDGER.record(
+            ctx.table,
+            shape_digest(self._last_shape_fp),
+            rows=out.stats.num_docs_scanned,
+            time_ms=out.stats.time_ms,
+            kernel_bytes=out.stats.kernel_bytes,
+            compile_ms=out.stats.compile_ms,
+            cache_hit=self._last_plan_cache_hit,
+            engine="dist",
+        )
         return out
 
     @staticmethod
@@ -368,6 +385,9 @@ class DistributedEngine:
                 params_structure(plan.params) == params_structure(cached.params)
                 and plan.row_sharded_params == cached.row_sharded_params
             ):
+                # cost model rides the cache entry — captured at the cached
+                # plan's first dispatch, never re-lowered on hits
+                plan.cost = cached.cost
                 DIST_AUDIT.record_hit(key[0])
                 self._last_plan_cache_hit = True
                 return plan
@@ -841,21 +861,64 @@ class DistributedEngine:
         keep_device = plan.kind == "groupby_sparse" and plan.sparse_merge_fn is not None
         batch_outs = []
         pending: List[Any] = []
+        launch_rows = stacked.num_shards * plan.batch_docs  # rows per launch
+        tl0 = time.perf_counter()
         with trace.span("launches") as lsp:
             for i, (cols, params) in enumerate(self.device_batches(plan, stacked)):
+                first_dispatch = i == 0 and plan.cost is None
+                if first_dispatch:
+                    # cost model captured ONCE per cached plan (per LAUNCH —
+                    # every batch shares the shape, so one model covers all)
+                    plan.cost = perf.capture_cost(
+                        plan.fn,
+                        (cols, params),
+                        perf.analytic_cost(
+                            launch_rows,
+                            perf.analytic_bytes_per_row(
+                                (stacked.column(n) for n in plan.needed_columns),
+                                bitmap_params=len(plan.row_sharded_params),
+                            ),
+                            kind=plan.kind,
+                            num_groups=plan.num_groups,
+                            num_entries=len(plan.aggs),
+                        ),
+                    )
+                td0 = time.perf_counter()
                 with trace.span(f"dispatch:{i}"):
                     pending.append(plan.fn(cols, params))
+                if first_dispatch:
+                    # the first jit dispatch pays trace+compile; its wall
+                    # time is the compile cost this query actually paid
+                    plan.cost.compile_ms = (time.perf_counter() - td0) * 1000.0
+                    stats.compile_ms += plan.cost.compile_ms + plan.cost.lower_ms
                 if len(pending) >= depth:
                     with trace.span("drain"):
                         batch_outs.append(self._drain(pending.pop(0), keep_device))
             while pending:
                 with trace.span("drain"):
                     batch_outs.append(self._drain(pending.pop(0), keep_device))
+            # every drain is a device_get fence, so the launches-section wall
+            # time bounds device compute — the roofline denominator here
+            launch_s = time.perf_counter() - tl0
+            total_bytes = total_flops = 0.0
+            if plan.cost is not None:
+                n_launches = len(plan.batch_offsets)
+                total_bytes = plan.cost.bytes_accessed * n_launches
+                total_flops = plan.cost.flops * n_launches
+                stats.kernel_bytes += total_bytes
+                stats.kernel_flops += total_flops
+                stats.kernel_cost_source = plan.cost.source
+                stats.device_ms += launch_s * 1000.0
             if lsp is not None:
+                roof = perf.roofline_pct(total_bytes, launch_s)
                 lsp.annotate(
                     batches=len(plan.batch_offsets),
                     pipelineDepth=depth,
                     backend=ops.scan_backend(),
+                    kernelBytes=total_bytes,
+                    kernelFlops=total_flops,
+                    costSource=plan.cost.source if plan.cost is not None else None,
+                    **({"rooflinePct": round(roof, 2)} if roof is not None else {}),
                 )
 
         if plan.kind == "aggregation":

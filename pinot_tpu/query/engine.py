@@ -19,6 +19,7 @@ from pinot_tpu.query.result import ExecutionStats, ResultTable
 from pinot_tpu.segment.segment import ImmutableSegment
 from pinot_tpu.spi.config import TableConfig
 from pinot_tpu.spi.schema import Schema
+from pinot_tpu.utils import perf
 
 
 @dataclass
@@ -138,16 +139,38 @@ class QueryEngine:
                 if executor.prune_segment(ctx, seg):
                     stats.num_segments_pruned += 1
                     continue
-                with trace.span(f"launch:{seg.name}"):
-                    pending.append(executor.launch_segment(ctx, seg, device=device))
+                with trace.span(f"launch:{seg.name}") as lsp:
+                    st = executor.launch_segment(ctx, seg, device=device)
+                    pending.append(st)
+                if lsp is not None and st[0] == "pending":
+                    # per-operator cost model on the launch span: EXPLAIN
+                    # ANALYZE and the trace view read these attributes
+                    lst = st[5]
+                    lsp.annotate(
+                        kernelBytes=lst.kernel_bytes,
+                        kernelFlops=lst.kernel_flops,
+                        costSource=lst.kernel_cost_source,
+                    )
             if trace.enabled:
                 # device/host time split: ONE fence over every pending output
                 # (trace-only — the untraced path lets collect's device_get
                 # fence so deadline checks stay responsive between collects)
                 import jax
 
-                with trace.span("device_wait", launches=len(pending)):
+                pend_bytes = sum(
+                    st[5].kernel_bytes for st in pending if st[0] == "pending"
+                )
+                tw = time.perf_counter()
+                with trace.span("device_wait", launches=len(pending)) as wsp:
                     jax.block_until_ready(executor.pending_outputs(pending))
+                wait_s = time.perf_counter() - tw
+                stats.device_ms = wait_s * 1000.0
+                if wsp is not None:
+                    roof = perf.roofline_pct(pend_bytes, wait_s)
+                    wsp.annotate(
+                        kernelBytes=pend_bytes,
+                        **({"rooflinePct": round(roof, 2)} if roof is not None else {}),
+                    )
             for st in pending:
                 deadline.check(f"query on {ctx.table}")
                 with trace.span("collect"):
@@ -155,6 +178,7 @@ class QueryEngine:
                 stats.num_segments_processed += 1
                 stats.num_docs_scanned += seg_stats.num_docs_scanned
                 stats.add_index_uses(seg_stats.filter_index_uses)
+                stats.add_kernel_cost(seg_stats)
                 results.append(res)
             deadline.check(f"query on {ctx.table}")
             with trace.span("reduce"):
@@ -170,6 +194,18 @@ class QueryEngine:
         out.stats.trace = trace.finish()
         METRICS.histogram("queryLatency").update(out.stats.time_ms)
         METRICS.counter("docsScanned").inc(stats.num_docs_scanned)
+        from pinot_tpu.query.shape import shape_digest
+
+        perf.PERF_LEDGER.record(
+            ctx.table,
+            shape_digest(ctx.shape_fingerprint()),
+            rows=out.stats.num_docs_scanned,
+            time_ms=out.stats.time_ms,
+            kernel_bytes=out.stats.kernel_bytes,
+            compile_ms=out.stats.compile_ms,
+            cache_hit=out.stats.compile_ms == 0.0,
+            engine="sse",
+        )
         return out
 
     def _explain_analyze(self, ctx: QueryContext, device=None) -> ResultTable:

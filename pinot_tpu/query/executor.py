@@ -12,11 +12,13 @@ launch, and the post-kernel decode (dense group table -> present keys, the
 sparse-groupby host fallback, selection row gather)."""
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from pinot_tpu.query import planner
+from pinot_tpu.utils import perf
 from pinot_tpu.query.functions import combine_field
 from pinot_tpu.query.ir import Expr, FilterNode, FilterOp, PredicateType, QueryContext
 from pinot_tpu.query.transform import eval_expr_host
@@ -118,7 +120,33 @@ def launch_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
     stats.filter_index_uses = tuple(plan.index_uses)
     cols = segment.to_device(device=device, columns=plan.needed_columns)
     params = {k: jax.device_put(v, device) for k, v in plan.params.items()}
+    first_launch = plan.cost is None
+    if first_launch:
+        # cost model captured ONCE per cached plan (hits copy it forward in
+        # plan_segment); racing first launches both capture — idempotent
+        plan.cost = perf.capture_cost(
+            plan.fn,
+            (cols, params),
+            perf.analytic_cost(
+                segment.num_docs,
+                perf.analytic_bytes_per_row(
+                    segment.column(n) for n in plan.needed_columns
+                ),
+                kind=plan.kind,
+                num_groups=plan.num_groups,
+                num_entries=len(plan.aggs),
+            ),
+        )
+    t0 = time.perf_counter()
     out = plan.fn(cols, params)  # async dispatch; device_get happens at collect
+    if first_launch:
+        # first jit dispatch pays trace+compile before enqueueing — its wall
+        # time IS the compile cost (AOT compile would pay it a second time)
+        plan.cost.compile_ms = (time.perf_counter() - t0) * 1000.0
+        stats.compile_ms = plan.cost.compile_ms + plan.cost.lower_ms
+    stats.kernel_bytes = plan.cost.bytes_accessed
+    stats.kernel_flops = plan.cost.flops
+    stats.kernel_cost_source = plan.cost.source
     return ("pending", ctx, segment, plan, out, stats)
 
 

@@ -141,6 +141,10 @@ class SegmentPlan:
     select_exprs: List[Expr] = field(default_factory=list)
     # (column, index kind) per index-accelerated filter predicate
     index_uses: List[Tuple[str, str]] = field(default_factory=list)
+    # kernel cost model (utils/perf.KernelCost), captured lazily at the
+    # FIRST launch of this plan and shared through the plan cache: hits
+    # copy the cached cost instead of re-lowering (None until captured)
+    cost: Optional[Any] = None
 
 
 # jit cache: (query SHAPE fingerprint, segment signature, backend) -> plan.
@@ -936,6 +940,9 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         # retrace, so it counts (and compiles) as a miss instead.
         plan = _build_plan(ctx, segment, needed, compiled_fn=cached.fn)
         if params_structure(plan.params) == params_structure(cached.params):
+            # cost model rides the cache entry: captured once at the first
+            # launch of the cached plan, never re-lowered on hits
+            plan.cost = cached.cost
             SSE_AUDIT.record_hit(key[0])
             return plan
     SSE_AUDIT.record_compile(key[0])

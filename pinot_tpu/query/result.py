@@ -42,6 +42,18 @@ class ExecutionStats:
     trace: Optional[dict] = None
     # broker/engine-minted request id (RequestContext requestId analog)
     query_id: Optional[str] = None
+    # kernel cost accounting (utils/perf.KernelCost, summed over every
+    # kernel launch this query dispatched): cost-model bytes/flops the
+    # compiled scans streamed, the lower+compile wall time paid by THIS
+    # query (0 on plan-cache hits), and where the model came from
+    # ("xla" | "analytic" | "mixed" across kernels)
+    kernel_bytes: float = 0.0
+    kernel_flops: float = 0.0
+    kernel_cost_source: Optional[str] = None
+    compile_ms: float = 0.0
+    # fence-bounded device-compute wall time (the device_wait span), when
+    # the execution path measured one — the roofline denominator
+    device_ms: float = 0.0
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_segments_queried += other.num_segments_queried
@@ -56,6 +68,20 @@ class ExecutionStats:
         self.exceptions.extend(other.exceptions)
         self.add_index_uses(other.filter_index_uses)
         self.query_id = self.query_id or other.query_id
+        self.add_kernel_cost(other)
+
+    def add_kernel_cost(self, other: "ExecutionStats") -> None:
+        """Accumulate just the kernel-cost slice of `other` (used by the
+        broker's scatter path, which merges the rest field-by-field)."""
+        from pinot_tpu.utils.perf import combine_sources
+
+        self.kernel_bytes += other.kernel_bytes
+        self.kernel_flops += other.kernel_flops
+        self.compile_ms += other.compile_ms
+        self.device_ms += other.device_ms
+        self.kernel_cost_source = combine_sources(
+            self.kernel_cost_source, other.kernel_cost_source
+        )
 
     def add_index_uses(self, uses: Tuple) -> None:
         """Order-preserving dedup-union into filter_index_uses."""

@@ -23,10 +23,22 @@ from __future__ import annotations
 import sys
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Hashable, Optional, Tuple
 
 from pinot_tpu.utils.metrics import METRICS
+
+# named caches register here (weakly — short-lived test caches vanish with
+# their last reference) so the perf observatory (/debug/perf, cli perf) can
+# report plan/result-cache occupancy alongside the ledger
+_NAMED_CACHES: "weakref.WeakValueDictionary[str, LruCache]" = weakref.WeakValueDictionary()
+
+
+def named_cache_stats() -> Dict[str, Dict[str, Any]]:
+    """entries/bytes per live named cache (compile.sse, compile.dist,
+    compile.mse, broker.resultCache, ...) — the /debug/perf cache view."""
+    return {name: cache.stats() for name, cache in sorted(_NAMED_CACHES.items())}
 
 
 def estimate_size(obj: Any, _depth: int = 0) -> int:
@@ -98,6 +110,8 @@ class LruCache:
         # key -> (value, nbytes, inserted_at_monotonic)
         self._entries: "OrderedDict[Hashable, Tuple[Any, int, float]]" = OrderedDict()
         self._bytes = 0
+        if name is not None:
+            _NAMED_CACHES[name] = self  # latest same-named cache wins
 
     def _charge(self, nbytes: int) -> bool:
         """Charge the shared budget (True when admitted or no budget)."""

@@ -269,6 +269,127 @@ def _prom_num(v: float) -> str:
     return f"{v:g}"
 
 
+def merge_registry_snapshots(registries: Dict[str, "MetricsRegistry"]) -> Dict[str, Any]:
+    """Cluster-level merge of per-source registries, with per-TYPE semantics:
+
+    - counters: SUM (monotone totals add across processes)
+    - gauges: LAST (point-in-time levels; the lexicographically last source
+      wins, deterministic for tests — a real scrape would use scrape time)
+    - timers: count/total SUM, max MAX (the slowest anywhere is the
+      cluster's max)
+    - histograms: bucket-wise SUM (cumulative bucket counts add exactly)
+
+    Source iteration is sorted by name so the merge is deterministic."""
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
+    timers: Dict[str, Dict[str, float]] = {}
+    hist_counts: Dict[str, List[int]] = {}
+    hist_meta: Dict[str, Dict[str, float]] = {}
+    hist_bounds: Dict[str, Tuple[float, ...]] = {}
+    for src in sorted(registries):
+        cs, gs, ts, hs = registries[src]._copies()
+        for name, c in cs.items():
+            counters[name] = counters.get(name, 0) + c.value
+        for name, g in gs.items():
+            gauges[name] = g.value  # last-wins
+        for name, t in ts.items():
+            with t._lock:
+                count, total, mx = t.count, t.total_ms, t.max_ms
+            agg = timers.setdefault(name, {"count": 0, "totalMs": 0.0, "maxMs": 0.0})
+            agg["count"] += count
+            agg["totalMs"] += total
+            agg["maxMs"] = max(agg["maxMs"], mx)
+        for name, h in hs.items():
+            with h._lock:
+                counts, total, count, mx = list(h.counts), h.sum_ms, h.count, h.max_ms
+            if name not in hist_counts:
+                hist_counts[name] = [0] * len(counts)
+                hist_bounds[name] = h.bounds
+                hist_meta[name] = {"count": 0, "sumMs": 0.0, "maxMs": 0.0}
+            if len(hist_counts[name]) == len(counts):
+                hist_counts[name] = [a + b for a, b in zip(hist_counts[name], counts)]
+            meta = hist_meta[name]
+            meta["count"] += count
+            meta["sumMs"] += total
+            meta["maxMs"] = max(meta["maxMs"], mx)
+    return {
+        "counters": counters,
+        "gauges": gauges,
+        "timers": {
+            k: {
+                "count": v["count"],
+                "meanMs": v["totalMs"] / v["count"] if v["count"] else 0.0,
+                "maxMs": v["maxMs"],
+            }
+            for k, v in timers.items()
+        },
+        "histograms": {
+            k: {"bounds": list(hist_bounds[k]), "counts": hist_counts[k], **hist_meta[k]}
+            for k in hist_counts
+        },
+    }
+
+
+def federate_prometheus(
+    registries: Dict[str, "MetricsRegistry"],
+    prefix: str = "pinot",
+    label: str = "server",
+) -> str:
+    """Prometheus text exposition of a fleet of registries: every series
+    appears once per source with a `{server="..."}` label, plus a merged
+    `{prefix}_cluster_*` aggregate per series using the
+    merge_registry_snapshots semantics (counters sum, gauges last, timers
+    sum+max, histogram buckets sum).  Per-source histogram buckets are
+    elided (series-count discipline) — the labeled `_sum`/`_count` pair plus
+    the merged cluster buckets carry the distribution."""
+    lines: List[str] = []
+    for src in sorted(registries):
+        counters, gauges, timers, hists = registries[src]._copies()
+        tag = f'{{{label}="{src}"}}'
+        for name, c in sorted(counters.items()):
+            lines.append(f"{prefix}_{_prom_name(name)}_total{tag} {c.value}")
+        for name, g in sorted(gauges.items()):
+            lines.append(f"{prefix}_{_prom_name(name)}{tag} {_prom_num(g.value)}")
+        for name, t in sorted(timers.items()):
+            s = t._snap()
+            full = f"{prefix}_{_prom_name(name)}_ms"
+            lines.append(f"{full}_sum{tag} {_prom_num(s['count'] * s['meanMs'])}")
+            lines.append(f"{full}_count{tag} {s['count']}")
+            lines.append(f"{full}_max{tag} {_prom_num(s['maxMs'])}")
+        for name, h in sorted(hists.items()):
+            s = h._snap()
+            full = f"{prefix}_{_prom_name(name)}_ms"
+            lines.append(f"{full}_sum{tag} {_prom_num(s['count'] * s['meanMs'])}")
+            lines.append(f"{full}_count{tag} {s['count']}")
+    merged = merge_registry_snapshots(registries)
+    cp = f"{prefix}_cluster"
+    for name, v in sorted(merged["counters"].items()):
+        full = f"{cp}_{_prom_name(name)}_total"
+        lines.append(f"# TYPE {full} counter")
+        lines.append(f"{full} {v}")
+    for name, v in sorted(merged["gauges"].items()):
+        full = f"{cp}_{_prom_name(name)}"
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full} {_prom_num(v)}")
+    for name, t in sorted(merged["timers"].items()):
+        full = f"{cp}_{_prom_name(name)}_ms"
+        lines.append(f"# TYPE {full} summary")
+        lines.append(f"{full}_sum {_prom_num(t['count'] * t['meanMs'])}")
+        lines.append(f"{full}_count {t['count']}")
+        lines.append(f"{full}_max {_prom_num(t['maxMs'])}")
+    for name, h in sorted(merged["histograms"].items()):
+        full = f"{cp}_{_prom_name(name)}_ms"
+        lines.append(f"# TYPE {full} histogram")
+        cum = 0
+        for bound, c in zip(h["bounds"], h["counts"]):
+            cum += c
+            lines.append(f'{full}_bucket{{le="{bound:g}"}} {cum}')
+        lines.append(f'{full}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{full}_sum {_prom_num(h['sumMs'])}")
+        lines.append(f"{full}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
 METRICS = MetricsRegistry()
 
 

@@ -36,9 +36,12 @@ def rng():
 
 @pytest.fixture(autouse=True)
 def _reset_metrics():
-    """The METRICS registry is process-global; without a reset, counter and
-    histogram assertions see spill-over from whichever tests ran before."""
+    """The METRICS registry and the perf ledger are process-global; without
+    a reset, counter/histogram assertions and federated per-server series
+    see spill-over from whichever tests ran before."""
     from pinot_tpu.utils.metrics import METRICS
+    from pinot_tpu.utils.perf import PERF_LEDGER
 
     METRICS.reset()
+    PERF_LEDGER.reset()
     yield

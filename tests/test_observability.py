@@ -366,7 +366,10 @@ class TestExplainAnalyze:
     def test_engine_operator_rows_join_measured_ms(self):
         eng = _engine()
         res = eng.query("EXPLAIN ANALYZE SELECT city, SUM(v) FROM t WHERE city = 'sf' GROUP BY city")
-        assert res.columns == ["Operator", "Operator_Id", "Parent_Id", "Actual_Ms", "Rows"]
+        assert res.columns == [
+            "Operator", "Operator_Id", "Parent_Id", "Actual_Ms", "Rows",
+            "Bytes", "Flops", "Roofline_Pct",
+        ]
         by_op = {r[0].split("(")[0]: r for r in res.rows if not r[0].startswith("TRACE")}
         assert by_op["BROKER_REDUCE"][3] is not None and by_op["BROKER_REDUCE"][3] >= 0
         assert by_op["GROUP_BY"][3] is not None and by_op["GROUP_BY"][3] > 0

@@ -587,6 +587,120 @@ class TestW016DurableWriteDiscipline:
         assert _rules(src, threaded=True) == ["W016"]
 
 
+class TestW017UnfencedDispatchTiming:
+    def test_flags_perf_counter_around_jitted_name_call(self):
+        src = """
+        import time
+        import jax
+
+        def kernel(x):
+            return x + x
+
+        kernel_jit = jax.jit(kernel)
+
+        def bench(x):
+            t0 = time.perf_counter()
+            y = kernel_jit(x)
+            dt = time.perf_counter() - t0
+            return y, dt
+        """
+        assert _rules(src) == ["W017"]
+
+    def test_flags_monotonic_around_decorated_jit(self):
+        src = """
+        import time
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return x + x
+
+        def bench(x):
+            t0 = time.monotonic()
+            y = kernel(x)
+            return time.monotonic() - t0
+        """
+        assert _rules(src) == ["W017"]
+
+    def test_quiet_with_fence_before_stop(self):
+        src = """
+        import time
+        import jax
+
+        def kernel(x):
+            return x + x
+
+        kernel_jit = jax.jit(kernel)
+
+        def bench(x):
+            t0 = time.perf_counter()
+            y = kernel_jit(x)
+            y.block_until_ready()
+            dt = time.perf_counter() - t0
+            return y, dt
+        """
+        assert _rules(src) == []
+
+    def test_quiet_with_fence_wrapping_dispatch(self):
+        src = """
+        import time
+        import jax
+
+        def kernel(x):
+            return x + x
+
+        kernel_jit = jax.jit(kernel)
+
+        def bench(x):
+            t0 = time.perf_counter()
+            y = jax.device_get(kernel_jit(x))
+            dt = time.perf_counter() - t0
+            return y, dt
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_attribute_call_dispatch(self):
+        # timing plan.fn(...) is the engine's compile_ms capture — the
+        # dispatch cost IS the measurement there, so attr calls are out of
+        # scope by design
+        src = """
+        import time
+        import jax
+
+        def kernel(x):
+            return x + x
+
+        kernel_jit = jax.jit(kernel)
+
+        def launch(plan, x):
+            t0 = time.perf_counter()
+            y = plan.fn(x)
+            dt = time.perf_counter() - t0
+            return y, dt
+        """
+        assert _rules(src) == []
+
+    def test_quiet_without_timer_or_without_dispatch(self):
+        src = """
+        import time
+        import jax
+
+        def kernel(x):
+            return x + x
+
+        kernel_jit = jax.jit(kernel)
+
+        def run(x):
+            return kernel_jit(x)
+
+        def host_only():
+            t0 = time.perf_counter()
+            total = sum(range(100))
+            return time.perf_counter() - t0, total
+        """
+        assert _rules(src) == []
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

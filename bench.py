@@ -186,6 +186,143 @@ def _overload_bench() -> dict:
     }
 
 
+def _concurrent_qps_bench() -> dict:
+    """Sustained QPS under 100+ simultaneous clients (round-12 concurrent
+    serving tier).  Two modes over identical same-fingerprint workloads
+    (one query shape, distinct literals — the regime cross-query batching
+    exists for):
+
+      batched:   clients call broker.submit(sql).result(); in-flight
+                 same-shape queries coalesce in the MicroBatcher (real
+                 wall-clock window, PINOT_TPU_BATCH_WAIT_MS) and execute
+                 as ONE vmapped plan launch per segment
+      unbatched: thread-per-request broker.query(sql) — the synchronous
+                 scatter path every client used before this tier
+
+    A mixed-shape leg runs the batched path over three distinct shapes to
+    exercise per-fingerprint grouping under a storm.  Reports sustained
+    QPS + client-observed p50/p95/p99 per mode and the speedup ratio;
+    `batched_qps` / `batch_speedup` feed the bench-history gate."""
+    import threading
+
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.coordinator import Coordinator
+    from pinot_tpu.cluster.server import ServerInstance
+    from pinot_tpu.query import executor as sse_executor
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.spi.config import SegmentsConfig, TableConfig
+    from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+    from pinot_tpu.utils.metrics import METRICS
+
+    schema = Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+    coord = Coordinator(replication=2)
+    for i in range(2):
+        coord.register_server(ServerInstance(f"server{i}"))
+    coord.add_table(schema, TableConfig(name="t", segments=SegmentsConfig(time_column="ts")))
+    rng = np.random.default_rng(23)
+    rows = int(os.environ.get("BENCH_QPS_ROWS", 20_000))
+    for i in range(4):
+        coord.add_segment(
+            "t",
+            build_segment(
+                schema,
+                {
+                    "city": rng.choice(["sf", "nyc", "la"], rows).astype(object),
+                    "v": rng.integers(0, 100, rows),
+                    "ts": 1_700_000_000_000 + rng.integers(0, 86_400_000, rows).astype(np.int64),
+                },
+                f"seg{i}",
+            ),
+        )
+    broker = Broker(coord)
+
+    shapes = [
+        lambda i: (
+            "SELECT city, COUNT(*), SUM(v) FROM t "
+            f"WHERE v < {50 + i % 40} GROUP BY city ORDER BY city"
+        ),
+        lambda i: f"SELECT COUNT(*), MAX(v) FROM t WHERE v > {i % 40}",
+        lambda i: f"SELECT city, SUM(v) FROM t WHERE v >= {i % 30} GROUP BY city ORDER BY city LIMIT 2",
+    ]
+
+    n_clients = int(os.environ.get("BENCH_QPS_CLIENTS", 120))
+    reqs = int(os.environ.get("BENCH_QPS_REQS", 2))
+
+    def run_mode(issue, sql_for) -> dict:
+        """All clients start behind one barrier; sustained QPS is completed
+        requests over the span from release to last join."""
+        lats = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(n_clients + 1)
+
+        def client(cid):
+            barrier.wait()
+            for r in range(reqs):
+                sql = sql_for(cid * reqs + r)
+                t0 = time.perf_counter()
+                issue(sql)
+                dt = (time.perf_counter() - t0) * 1000.0
+                with lock:
+                    lats.append(dt)
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        arr = np.asarray(lats)
+        return {
+            "qps": round(len(lats) / wall, 1),
+            "wall_s": round(wall, 4),
+            "requests": len(lats),
+            "p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p95_ms": round(float(np.percentile(arr, 95)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        }
+
+    # warm every shape through both paths so neither mode pays compiles:
+    # one sync query (base plan) + one full-width batch (vmapped plan)
+    for sh in shapes:
+        broker.query(sh(0))
+        futs = [broker.submit(sh(j)) for j in range(sse_executor.batch_width())]
+        broker.drain_batches()
+        for f in futs:
+            f.result()
+
+    sse_executor.BATCH_AUDIT.reset()
+    b0 = METRICS.counter("broker.batches").value
+    batched = run_mode(lambda s: broker.submit(s).result(), shapes[0])
+    batched["batches"] = METRICS.counter("broker.batches").value - b0
+    batched["batch_compiles"] = sse_executor.BATCH_AUDIT.snapshot()["compiles"]
+    unbatched = run_mode(broker.query, shapes[0])
+    mixed = run_mode(
+        lambda s: broker.submit(s).result(), lambda i: shapes[i % len(shapes)](i)
+    )
+    speedup = round(batched["qps"] / unbatched["qps"], 3) if unbatched["qps"] else None
+    return {
+        "clients": n_clients,
+        "requests_per_client": reqs,
+        "rows_per_segment": rows,
+        "batched": batched,
+        "unbatched": unbatched,
+        "mixed_shapes_batched": mixed,
+        "batch_speedup": speedup,
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -474,6 +611,7 @@ def main() -> None:
         "effective_bytes_per_sec": round(rows_per_sec * bytes_per_row, 1),
         "roofline": roofline,
         "overload": _overload_bench(),
+        "concurrent_qps": _concurrent_qps_bench(),
     }
     print(json.dumps(report))
 

@@ -82,6 +82,20 @@ been bitten by (ADVICE r5) or that silently degrades TPU throughput:
                               calls (`plan.fn(...)`) are out of scope:
                               engine code deliberately times dispatch cost
                               there (compile_ms capture).
+  W018 blocking-in-dispatch   a blocking call (time.sleep, block_until_ready,
+                              synchronous device_get/.item()/.tolist(),
+                              socket recv/sendall/accept/connect) inside the
+                              async batch-dispatch path: a method of a
+                              *Batcher class, or a pump/_pump/
+                              *dispatch_loop* function.  The batcher's
+                              worker/pump drains EVERY key's pending groups —
+                              one blocking call there head-of-line blocks
+                              every coalesced query, exactly the stall the
+                              async broker tier exists to avoid.
+                              `Condition.wait` is the sanctioned deadline
+                              wakeup and stays clean; device fences belong
+                              in the submitting caller's thread
+                              (Future.result) or the runner's collect.
 
 Kernel bodies (W001/W002 scope) are functions the module jits: decorated
 with @jax.jit / @partial(jax.jit, ...) or passed by name to jax.jit(...)
@@ -115,6 +129,7 @@ RULES: Dict[str, str] = {
     "W015": "unbounded container growth on a cluster serving path (no bound/eviction)",
     "W016": "non-durable write to a durability path (no tmp-fsync-replace discipline)",
     "W017": "wall-clock timing around an async jitted dispatch without a device fence before the stop timestamp",
+    "W018": "blocking call (sleep/device fence/socket I/O) inside an async batch-dispatch path",
     # interprocedural passes (analysis/races.py, analysis/device_sync.py —
     # run via analysis/engine.py over the whole package, not per-file):
     "W010": "lock-guarded attribute read/written without holding its lock",
@@ -272,11 +287,17 @@ def _check_sync_in_loop(path: str, tree: ast.AST, findings: List[Finding]) -> No
     walk(tree, 0)
 
 
+def _is_lock_name(name: str) -> bool:
+    # condition variables count: `with self._cv:` acquires the underlying lock
+    low = name.lower()
+    return "lock" in low or "cond" in low or low.lstrip("_") == "cv"
+
+
 def _mentions_lock(node: ast.AST) -> bool:
     for n in ast.walk(node):
-        if isinstance(n, ast.Attribute) and "lock" in n.attr.lower():
+        if isinstance(n, ast.Attribute) and _is_lock_name(n.attr):
             return True
-        if isinstance(n, ast.Name) and "lock" in n.id.lower():
+        if isinstance(n, ast.Name) and _is_lock_name(n.id):
             return True
     return False
 
@@ -1067,10 +1088,58 @@ def is_suppressed(f: Finding, suppressions: Dict[int, Optional[Set[str]]]) -> bo
     return rules is None or f.rule in rules
 
 
+_W018_BLOCKING_ATTRS = frozenset({
+    "block_until_ready", "device_get", "recv", "recv_into", "sendall",
+    "accept", "connect", "create_connection", "item", "tolist",
+})
+
+
+def _check_w018(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """Blocking call inside the async batch-dispatch path.  Scope: methods
+    of classes named *Batcher*, plus functions named pump/_pump or
+    containing "dispatch_loop".  These run under (or are the tick of) the
+    coalescing scheduler — a sleep, device fence, host-sync (.item/.tolist)
+    or socket wait there stalls every key's pending groups at once.
+    Condition.wait (the timed wakeup) is deliberately out of the blocking
+    set: it is how the worker sleeps WITHOUT holding up a flush."""
+    scopes: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and "Batcher" in node.name:
+            scopes.extend(
+                n for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in ("pump", "_pump") or "dispatch_loop" in node.name:
+                scopes.append(node)
+    seen: Set[int] = set()
+    for fn in scopes:
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            blocked = None
+            if isinstance(f, ast.Name) and f.id == "sleep":
+                blocked = "sleep"
+            elif isinstance(f, ast.Attribute):
+                if f.attr == "sleep" or f.attr in _W018_BLOCKING_ATTRS:
+                    blocked = f.attr
+            if blocked:
+                findings.append(Finding(
+                    path, n.lineno, "W018",
+                    f"blocking call `{blocked}` inside async batch-dispatch "
+                    f"path `{fn.name}` — head-of-line blocks every coalesced query",
+                ))
+
+
 def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> List[Finding]:
     """Lint one module's source.  `threaded` enables the cluster/-scoped
     rules (W004 shared-state races, W006 swallowed exceptions, W015
-    unbounded serving-path growth)."""
+    unbounded serving-path growth, W018 blocking calls in async
+    batch-dispatch paths)."""
     findings: List[Finding] = []
     try:
         tree = ast.parse(src)
@@ -1099,6 +1168,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)
         _check_w015(path, tree, findings)
+        _check_w018(path, tree, findings)
     suppressions = parse_suppressions(src)
     if suppressions:
         findings = [f for f in findings if not is_suppressed(f, suppressions)]

@@ -486,6 +486,56 @@ class IntegerTupleSketchFunction(AggFunction):
 # ---------------------------------------------------------------------------
 # Funnel family: per-step correlate-key presence bitmaps
 # ---------------------------------------------------------------------------
+def _ordered_funnel_reach(codes, steps, ts, mask, cells, window):
+    """Deepest ORDERED funnel step per correlate key: [cells] int32.
+
+    Device kernel: stable-sort rows by (key, ts), then one lax.scan over the
+    sorted rows carrying per-step chain-START timestamps.  DP invariant:
+    carry[s] is the LATEST start time of any chain that has reached step
+    s+1 — a later start never has less window slack, so keeping the max is
+    exact (equals the brute-force over all chains).  An event extends step
+    s from the PRE-update carry[s-1], so one row never serves two
+    consecutive steps (strict event ordering).  Per-row reach scatter-maxes
+    into a [cells+1] table; masked rows ride the sentinel slot and drop.
+
+    The scan is sequential over rows — correctness-first; the unordered
+    set-intersection path (no TIMESTAMPBY) remains the fast default.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    S = len(steps)
+    key = jnp.where(mask, codes.astype(jnp.int32), jnp.int32(cells))
+    # x64 is enabled package-wide: float64 carries epoch-ms exactly (< 2^53)
+    tsv = ts.astype(jnp.float64)
+    sorted_ops = lax.sort(
+        (key, tsv) + tuple(s.astype(bool) for s in steps), num_keys=2
+    )
+    key_s, ts_s = sorted_ops[0], sorted_ops[1]
+    smat_s = jnp.stack(sorted_ops[2:], axis=1)  # [N, S]
+    NEG = jnp.float64(-(2.0 ** 62))
+    win = jnp.float64(window)
+
+    def body(carry, x):
+        prev, pkey = carry
+        k, t, srow = x
+        prev = jnp.where(k != pkey, NEG, prev)  # new key: reset the chains
+        started = jnp.where(srow[0], t, prev[0])
+        if S > 1:
+            ext = srow[1:] & (prev[:-1] > NEG) & (t - prev[:-1] <= win)
+            rest = jnp.where(ext, jnp.maximum(prev[1:], prev[:-1]), prev[1:])
+            new = jnp.concatenate([started[None], rest])
+        else:
+            new = started[None]
+        reach = (new > NEG).sum().astype(jnp.int32)
+        return (new, k), reach
+
+    init = (jnp.full((S,), NEG, jnp.float64), jnp.int32(-1))
+    _, reach = lax.scan(body, init, (key_s, ts_s, smat_s))
+    tbl = jnp.zeros((cells + 1,), jnp.int32).at[key_s].max(reach)
+    return tbl[:cells]
+
+
 class FunnelCountFunction(AggFunction):
     """FUNNELCOUNT(STEPS(cond1, ..., condS), CORRELATEBY(col)) — per step s,
     how many correlate keys matched ALL of steps 1..s (set-intersection
@@ -497,7 +547,17 @@ class FunnelCountFunction(AggFunction):
     (scatter-or via group_count>0) — an additive [S, domain] int32 tensor
     partial that merges by max and psums across shards; the prefix-AND and
     counting happen at final over the table-sized array.  Keys need a
-    shared dictionary or bounded int range (like exact DISTINCTCOUNT)."""
+    shared dictionary or bounded int range (like exact DISTINCTCOUNT).
+
+    ORDERED mode (TIMESTAMPBY(col) [, window] — ADVICE r5): the
+    set-intersection form inflates because it ignores event order; with a
+    timestamp the per-segment partial becomes deepest-REACHED-step per key
+    (_ordered_funnel_reach: sorted scan, window measured from the chain's
+    first step).  present[s] = reach > s is prefix-monotone, so the same
+    max-merge and cumprod final apply unchanged.  Caveat: reach merges
+    across segments by MAX — a chain whose steps span two segments of one
+    key is undercounted (never inflated); co-partition events by correlate
+    key for exact multi-segment results."""
 
     name = "funnelcount"
     needs_codes = True
@@ -508,14 +568,33 @@ class FunnelCountFunction(AggFunction):
     mode = "counts"  # counts | complete | maxstep
     input_kind = "codes"
 
-    def __init__(self, domain: int = 0, base: int = 0, input_kind: str = "codes"):
+    def __init__(
+        self,
+        domain: int = 0,
+        base: int = 0,
+        input_kind: str = "codes",
+        ordered: bool = False,
+        window: float = float("inf"),
+    ):
         self.domain = domain
         self.base = base
         self.input_kind = input_kind
+        self.ordered = ordered
+        self.window = window
 
     def _rebind(self, **kw):
-        out = type(self)(**kw)
-        return out
+        cur = dict(
+            domain=self.domain, base=self.base, input_kind=self.input_kind,
+            ordered=self.ordered, window=self.window,
+        )
+        cur.update(kw)
+        return type(self)(**cur)
+
+    def with_args(self, literal_args):
+        if not literal_args:
+            return self
+        # parser emits literal_args=(window,) iff TIMESTAMPBY is present
+        return self._rebind(ordered=True, window=float(literal_args[0]))
 
     def bind_column(self, info: ColumnBinding):
         if info.kind == "dict":
@@ -529,6 +608,13 @@ class FunnelCountFunction(AggFunction):
     def partial(self, values, mask):
         import jax.numpy as jnp
 
+        if self.ordered:
+            codes, *rest = values
+            steps, ts = rest[:-1], rest[-1]
+            _check_cell_budget(self.name, len(steps), self.domain)
+            tbl = _ordered_funnel_reach(codes, steps, ts, mask, self.domain, self.window)
+            rows = [(tbl > s).astype(jnp.int32) for s in range(len(steps))]
+            return {"present": jnp.stack(rows, axis=0)}  # [S, domain]
         codes, *steps = values
         _check_cell_budget(self.name, len(steps), self.domain)
         rows = [
@@ -540,6 +626,16 @@ class FunnelCountFunction(AggFunction):
     def partial_grouped(self, values, mask, keys, num_groups):
         import jax.numpy as jnp
 
+        if self.ordered:
+            codes, *rest = values
+            steps, ts = rest[:-1], rest[-1]
+            _check_cell_budget(self.name, num_groups * len(steps), self.domain)
+            flat = keys.astype(jnp.int32) * np.int32(self.domain) + codes
+            cells = num_groups * self.domain
+            tbl = _ordered_funnel_reach(flat, steps, ts, mask, cells, self.window)
+            tbl = tbl.reshape(num_groups, self.domain)
+            rows = [(tbl > s).astype(jnp.int32) for s in range(len(steps))]
+            return {"present": jnp.stack(rows, axis=1)}  # [G, S, domain]
         codes, *steps = values
         _check_cell_budget(self.name, num_groups * len(steps), self.domain)
         flat = keys.astype(jnp.int32) * np.int32(self.domain) + codes

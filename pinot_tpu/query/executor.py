@@ -12,6 +12,8 @@ launch, and the post-kernel decode (dense group table -> present keys, the
 sparse-groupby host fallback, selection row gather)."""
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -155,7 +157,7 @@ def pending_outputs(states) -> list:
     tracing layer fences on ALL of these with ONE jax.block_until_ready to
     split device compute time from host dispatch (never per-launch: a
     per-launch fence in the loop would serialize the pipeline, lint W002)."""
-    return [st[4] for st in states if st[0] == "pending"]
+    return [st[4] for st in states if st[0] in ("pending", "pending_batch")]
 
 
 def collect_segment(state):
@@ -165,14 +167,20 @@ def collect_segment(state):
     if state[0] == "done":
         return state[1]
     _, ctx, segment, plan, out, stats = state
+    host = jax.device_get(out)
+    return _decode_host(ctx, segment, plan, host, stats)
 
+
+def _decode_host(ctx, segment, plan, host, stats):
+    """Host-side decode of one query's (already fetched) kernel outputs —
+    shared by the unbatched collect and the per-member unstack of a
+    cross-query batched launch."""
     if plan.kind == "aggregation":
-        partials = jax.device_get(out)
-        partials = [fn.host_partial(p) for fn, p in zip(plan.aggs, partials)]
+        partials = [fn.host_partial(p) for fn, p in zip(plan.aggs, host)]
         return AggSegmentResult(partials=partials), stats
 
     if plan.kind == "groupby_dense":
-        presence, partials = jax.device_get(out)
+        presence, partials = host
         dense = DenseGroupData(
             presence=presence,
             partials=partials,
@@ -187,7 +195,7 @@ def collect_segment(state):
         return GroupBySegmentResult(keys=keys, partials=sliced, dense=dense), stats
 
     if plan.kind == "groupby_sparse":
-        uniq, partials = jax.device_get(out)
+        uniq, partials = host
         res = sparse_tables_to_result(
             plan.group_dims, plan.aggs, uniq, partials, ctx.num_groups_limit,
             order_trim=planner.order_by_agg_index(ctx),
@@ -196,8 +204,193 @@ def collect_segment(state):
         return res, stats
 
     # selection
-    tmask = np.asarray(jax.device_get(out))
+    tmask = np.asarray(host)
     return _gather_selection(ctx, plan, segment, tmask), stats
+
+
+# ---------------------------------------------------------------------------
+# cross-query vmap batching (the concurrent serving tier's kernel layer)
+# ---------------------------------------------------------------------------
+
+
+class BatchShapeError(RuntimeError):
+    """Batch members do not share one compiled plan — callers must fall
+    back to per-member execution (never a user-visible failure)."""
+
+
+class BatchAudit:
+    """Counts vmapped-plan compiles vs. cache hits, mirroring SSE_AUDIT for
+    the base plans: the ≤2-compiles-per-shape guarantee is 1 base compile
+    (SSE_AUDIT) + 1 batched compile (here)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compiles = 0
+        self.hits = 0
+
+    def record_compile(self):
+        with self._lock:
+            self.compiles += 1
+
+    def record_hit(self):
+        with self._lock:
+            self.hits += 1
+
+    def reset(self):
+        with self._lock:
+            self.compiles = 0
+            self.hits = 0
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"compiles": self.compiles, "hits": self.hits}
+
+
+BATCH_AUDIT = BatchAudit()
+
+
+def batch_width() -> int:
+    """Fixed lane count of a batched launch (PINOT_TPU_BATCH_MAX).  Partial
+    batches pad to this width by repeating the last member's params, so
+    every batched launch of a given plan shares ONE compiled vmap kernel."""
+    return max(2, int(os.environ.get("PINOT_TPU_BATCH_MAX", "8")))
+
+
+def _batch_fn_cache():
+    global _BATCH_FN_CACHE
+    if _BATCH_FN_CACHE is None:
+        from pinot_tpu.utils.cache import LruCache
+
+        _BATCH_FN_CACHE = LruCache(
+            max_entries=int(os.environ.get("PINOT_TPU_BATCH_PLAN_ENTRIES", "64")),
+            name="compile.batch",
+        )
+    return _BATCH_FN_CACHE
+
+
+_BATCH_FN_CACHE = None
+
+
+def launch_segment_batch(ctxs: List[QueryContext], segment: ImmutableSegment, device=None):
+    """Dispatch N same-shape queries over one segment as a SINGLE vmapped
+    kernel launch: member literal-parameter pytrees stack along a leading
+    `query` axis (r9 made literals device args, so stacking needs no
+    retrace), segment columns are shared (in_axes None), and the vmapped
+    jitted fn lives in a bounded LRU keyed on the plan-cache key + lane
+    width so batching never causes recompile churn.
+
+    Per-member ExecutionStats divide the physical launch's cost — docs
+    scanned, kernel bytes/flops — across the N live members (padding lanes
+    attributed to nobody), so summing member stats reproduces ONE unbatched
+    run of the same query, not N copies.  compile_ms lands on member 0.
+
+    Raises BatchShapeError when members don't resolve to one compiled plan
+    (callers fall back to per-member launches).  Star-tree shortcuts are
+    intentionally not taken here — members were vetted as batchable by the
+    broker before coalescing."""
+    import jax
+
+    n = len(ctxs)
+    if n < 1:
+        raise ValueError("launch_segment_batch needs at least one member")
+    plans = [planner.plan_segment(ctx, segment) for ctx in ctxs]
+    base = plans[0]
+    for p in plans[1:]:
+        if p.fn is not base.fn or p.kind != base.kind:
+            raise BatchShapeError(
+                "batch members resolved to different compiled plans"
+            )
+    width = batch_width()
+    if n > width:
+        raise BatchShapeError(f"batch of {n} exceeds lane width {width}")
+
+    shared_keys = frozenset(k for k in base.params if k == "__valid__")
+    params_list = [p.params for p in plans]
+    if n < width:
+        params_list = params_list + [plans[-1].params] * (width - n)
+    cols = segment.to_device(device=device, columns=base.needed_columns)
+    stacked = {}
+    for k, v0 in base.params.items():
+        if k in shared_keys:
+            stacked[k] = jax.device_put(v0, device)
+        else:
+            stacked[k] = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                    *(pl[k] for pl in params_list),
+                ),
+                device,
+            )
+
+    key = (base.cache_key or id(base.fn), width, shared_keys)
+    cache = _batch_fn_cache()
+    fnb = cache.get(key)
+    first_batched = fnb is None
+    if first_batched:
+        axes = {k: (None if k in shared_keys else 0) for k in base.params}
+        fnb = jax.jit(jax.vmap(base.fn, in_axes=(None, axes)))
+        cache.put(key, fnb)
+        BATCH_AUDIT.record_compile()
+    else:
+        BATCH_AUDIT.record_hit()
+
+    if base.cost is None:
+        # same single-lane cost model as launch_segment, so per-member
+        # shares divide the identical numbers an unbatched run reports
+        single = {k: jax.device_put(v, device) for k, v in base.params.items()}
+        base.cost = perf.capture_cost(
+            base.fn,
+            (cols, single),
+            perf.analytic_cost(
+                segment.num_docs,
+                perf.analytic_bytes_per_row(
+                    segment.column(nm) for nm in base.needed_columns
+                ),
+                kind=base.kind,
+                num_groups=base.num_groups,
+                num_entries=len(base.aggs),
+            ),
+        )
+    t0 = time.perf_counter()
+    out = fnb(cols, stacked)  # async dispatch; one device_get at collect
+    # deliberately times the dispatch: the first vmapped call pays
+    # trace+compile inline, and THAT is the cost being recorded
+    compile_ms = (time.perf_counter() - t0) * 1000.0 if first_batched else 0.0  # pinot-lint: disable=W017
+
+    docs = segment.num_docs
+    share, rem = divmod(docs, n)
+    stats_list = []
+    for i in range(n):
+        st = ExecutionStats(
+            num_segments_queried=1,
+            num_segments_processed=1,
+            num_docs_scanned=share + (1 if i < rem else 0),
+            total_docs=docs,
+        )
+        st.filter_index_uses = tuple(plans[i].index_uses)
+        st.kernel_bytes = base.cost.bytes_accessed / n
+        st.kernel_flops = base.cost.flops / n
+        st.kernel_cost_source = base.cost.source
+        stats_list.append(st)
+    if first_batched:
+        stats_list[0].compile_ms = compile_ms + base.cost.lower_ms
+    return ("pending_batch", ctxs, segment, plans, out, stats_list)
+
+
+def collect_segment_batch(state):
+    """Phase 2 of a batched launch: ONE device_get fence for all members,
+    then per-member unstack (leading `query` axis) and host decode via the
+    same path the unbatched collect uses — batched results are bit-exact
+    vs. sequential execution."""
+    import jax
+
+    _, ctxs, segment, plans, out, stats_list = state
+    host = jax.device_get(out)
+    results = []
+    for i, (ctx, plan, st) in enumerate(zip(ctxs, plans, stats_list)):
+        member = jax.tree_util.tree_map(lambda a: a[i], host)
+        results.append(_decode_host(ctx, segment, plan, member, st))
+    return results
 
 
 def execute_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):

@@ -145,6 +145,10 @@ class SegmentPlan:
     # FIRST launch of this plan and shared through the plan cache: hits
     # copy the cached cost instead of re-lowering (None until captured)
     cost: Optional[Any] = None
+    # plan-cache key (shape fp, segment signature, backend) — the stable
+    # identity the cross-query batcher keys its vmapped-fn LRU on, so
+    # batching never compiles more than once per (shape, batch width)
+    cache_key: Optional[Tuple] = None
 
 
 # jit cache: (query SHAPE fingerprint, segment signature, backend) -> plan.
@@ -943,10 +947,12 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
             # cost model rides the cache entry: captured once at the first
             # launch of the cached plan, never re-lowered on hits
             plan.cost = cached.cost
+            plan.cache_key = key
             SSE_AUDIT.record_hit(key[0])
             return plan
     SSE_AUDIT.record_compile(key[0])
     plan = _build_plan(ctx, segment, needed, compiled_fn=None)
+    plan.cache_key = key
     _PLAN_CACHE.put(key, plan)
     return plan
 

@@ -772,7 +772,28 @@ class _Parser:
                 raise SqlParseError(
                     f"{e.op.upper()} needs STEPS(cond, ...) and CORRELATEBY(column) arguments"
                 )
-            return AggregationSpec(e.op, corr.args[0], extra_exprs=tuple(steps.args))
+            # TIMESTAMPBY(col) [, window] selects the ORDERED funnel: steps
+            # must occur in timestamp order per correlate key, optionally
+            # all within `window` (same units as the timestamp column) of
+            # the chain's first step.  The ts expr rides as the LAST extra
+            # expr; the window literal flags ordered mode downstream.
+            tsby = next(
+                (a for a in args if not a.is_literal and a.op in ("timestampby", "timestamp_by")),
+                None,
+            )
+            window = next((a.value for a in args if a.is_literal), None)
+            extra = tuple(steps.args)
+            lits = ()
+            if tsby is not None:
+                if len(tsby.args) != 1:
+                    raise SqlParseError(f"{e.op.upper()} TIMESTAMPBY takes exactly one column")
+                extra = extra + (tsby.args[0],)
+                lits = (float(window) if window is not None else float("inf"),)
+            elif window is not None:
+                raise SqlParseError(
+                    f"{e.op.upper()} window argument requires TIMESTAMPBY(column)"
+                )
+            return AggregationSpec(e.op, corr.args[0], extra_exprs=extra, literal_args=lits)
         expr = args[0] if args else None
         lits = tuple(a.value for a in args[1:] if a.is_literal)
         extra = tuple(a for a in args[1:] if not a.is_literal)

@@ -399,6 +399,7 @@ GATE_METRICS: Tuple[str, ...] = (
     "e2e_rows_per_sec",
     "warm_p50_rows_per_sec",
     "effective_bytes_per_sec",
+    "batched_qps",
 )
 
 # Allowance bounds: at least 15% slack (CI-grade CPU runs are noisy even
@@ -414,6 +415,7 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
     gate compares.  Timestamps are stamped by the caller (bench.py)."""
     sweep = report.get("distinct_literal_sweep", {}) or {}
     roofline = report.get("roofline", {}) or {}
+    qps = report.get("concurrent_qps", {}) or {}
     return {
         "schema": 1,
         "bench": bench,
@@ -428,6 +430,9 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
             "cost_bytes_per_sec": roofline.get("cost_bytes_per_sec"),
             "roofline_pct": roofline.get("kernel_roofline_pct"),
             "plan_cache_hit_rate": (report.get("plan_cache", {}) or {}).get("hit_rate"),
+            "batched_qps": (qps.get("batched", {}) or {}).get("qps"),
+            "unbatched_qps": (qps.get("unbatched", {}) or {}).get("qps"),
+            "batch_speedup": qps.get("batch_speedup"),
         },
         "noise": {"run_variance": report.get("run_variance", 0.0)},
     }

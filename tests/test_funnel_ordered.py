@@ -1,0 +1,191 @@
+"""Ordered funnel (TIMESTAMPBY) — ADVICE r5: the set-intersection funnel
+ignores event order and inflates; the ordered form counts a step only when
+it occurs AFTER the chain's previous step (optionally within a window of
+the chain's first step).  Golden model: brute-force per-key DP in Python.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.query.engine import QueryEngine
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.schema import DataType, FieldSpec, Schema
+
+CONDS = ["/home", "/product", "/cart", "/checkout"]
+STEPS_SQL = (
+    "STEPS(url = '/home', url = '/product', url = '/cart', url = '/checkout')"
+)
+
+
+def _schema():
+    return Schema(
+        "events",
+        [
+            FieldSpec("uid", DataType.LONG),
+            FieldSpec("url", DataType.STRING),
+            FieldSpec("ts", DataType.LONG),
+        ],
+    )
+
+
+def _world(n=4000, keys=120, seed=5, n_segments=1, partition_by_key=False):
+    """partition_by_key keeps each uid's events in ONE segment — the regime
+    where multi-segment ordered results are exact (reach merges by max)."""
+    rng = np.random.default_rng(seed)
+    uid = rng.integers(0, keys, n).astype(np.int64)
+    url = rng.choice(CONDS, n, p=[0.4, 0.3, 0.2, 0.1])
+    ts = rng.integers(0, 100_000, n).astype(np.int64)
+    eng = QueryEngine()
+    eng.register_table(_schema())
+    if n_segments == 1:
+        parts = [np.arange(n)]
+    elif partition_by_key:
+        parts = [np.where(uid % n_segments == i)[0] for i in range(n_segments)]
+    else:
+        parts = np.array_split(np.arange(n), n_segments)
+    for i, idx in enumerate(parts):
+        eng.add_segment(
+            "events",
+            build_segment(
+                _schema(),
+                {"uid": uid[idx], "url": url[idx], "ts": ts[idx]},
+                f"s{i}",
+            ),
+        )
+    return eng, uid, url, ts
+
+
+def _oracle_reach(uid, url, ts, conds, window=float("inf")):
+    """Per-key deepest ordered step: DP over time-sorted events carrying the
+    latest chain-start timestamp per step (mirrors the device scan)."""
+    S = len(conds)
+    state = {}
+    for i in np.argsort(ts, kind="stable"):
+        u, t = uid[i], ts[i]
+        prev = state.setdefault(u, [None] * S)
+        new = list(prev)
+        if url[i] == conds[0]:
+            new[0] = t
+        for s in range(1, S):
+            if url[i] == conds[s] and prev[s - 1] is not None and t - prev[s - 1] <= window:
+                new[s] = prev[s - 1] if prev[s] is None else max(prev[s], prev[s - 1])
+        state[u] = new
+    return {u: sum(1 for v in st if v is not None) for u, st in state.items()}
+
+
+def _expected(reach, n_steps):
+    counts = [sum(1 for r in reach.values() if r > s) for s in range(n_steps)]
+    complete = sum(1 for r in reach.values() if r >= n_steps)
+    maxstep = max(reach.values()) if reach else 0
+    return counts, complete, maxstep
+
+
+class TestOrderedFunnel:
+    @pytest.mark.parametrize("window_sql,window", [("", float("inf")), (", 20000", 20000)])
+    def test_oracle_parity_single_segment(self, window_sql, window):
+        eng, uid, url, ts = _world()
+        counts, complete, maxstep = _expected(_oracle_reach(uid, url, ts, CONDS, window), 4)
+        got = eng.query(
+            f"SELECT FUNNELCOUNT({STEPS_SQL}, CORRELATEBY(uid), TIMESTAMPBY(ts){window_sql}) "
+            "FROM events"
+        ).rows[0][0]
+        assert got == counts
+        row = eng.query(
+            f"SELECT FUNNELCOMPLETECOUNT({STEPS_SQL}, CORRELATEBY(uid), TIMESTAMPBY(ts){window_sql}), "
+            f"FUNNELMAXSTEP({STEPS_SQL}, CORRELATEBY(uid), TIMESTAMPBY(ts){window_sql}) FROM events"
+        ).rows[0]
+        assert int(row[0]) == complete
+        assert int(row[1]) == maxstep
+
+    def test_ordered_never_exceeds_set_form(self, ):
+        eng, uid, url, ts = _world(seed=9)
+        unordered = eng.query(
+            f"SELECT FUNNELCOMPLETECOUNT({STEPS_SQL}, CORRELATEBY(uid)) FROM events"
+        ).rows[0][0]
+        ordered = eng.query(
+            f"SELECT FUNNELCOMPLETECOUNT({STEPS_SQL}, CORRELATEBY(uid), TIMESTAMPBY(ts)) "
+            "FROM events"
+        ).rows[0][0]
+        assert int(ordered) <= int(unordered)
+
+    def test_order_actually_enforced(self):
+        """One key sees checkout BEFORE the earlier steps: the set form
+        counts it complete, the ordered form must not."""
+        eng = QueryEngine()
+        eng.register_table(_schema())
+        data = {
+            "uid": np.array([1, 1, 1, 1, 2, 2, 2, 2], dtype=np.int64),
+            # uid 1 in order; uid 2 reversed
+            "url": np.array(CONDS + CONDS[::-1], dtype=object),
+            "ts": np.array([10, 20, 30, 40, 10, 20, 30, 40], dtype=np.int64),
+        }
+        eng.add_segment("events", build_segment(_schema(), data, "s0"))
+        set_form = eng.query(
+            f"SELECT FUNNELCOMPLETECOUNT({STEPS_SQL}, CORRELATEBY(uid)) FROM events"
+        ).rows[0][0]
+        ordered = eng.query(
+            f"SELECT FUNNELCOMPLETECOUNT({STEPS_SQL}, CORRELATEBY(uid), TIMESTAMPBY(ts)) "
+            "FROM events"
+        ).rows[0][0]
+        assert int(set_form) == 2  # both keys hit all 4 urls
+        assert int(ordered) == 1  # only uid 1 hit them in order
+
+    def test_window_bounds_chain_from_first_step(self):
+        eng = QueryEngine()
+        eng.register_table(_schema())
+        data = {
+            "uid": np.array([1, 1, 1, 2, 2, 2], dtype=np.int64),
+            "url": np.array(
+                ["/home", "/product", "/cart", "/home", "/product", "/cart"], dtype=object
+            ),
+            # uid 1 finishes within 50 of its start; uid 2 strays past it
+            "ts": np.array([0, 20, 50, 0, 20, 51], dtype=np.int64),
+        }
+        eng.add_segment("events", build_segment(_schema(), data, "s0"))
+        q = (
+            "SELECT FUNNELCOMPLETECOUNT(STEPS(url = '/home', url = '/product', url = '/cart'), "
+            "CORRELATEBY(uid), TIMESTAMPBY(ts), 50) FROM events"
+        )
+        assert int(eng.query(q).rows[0][0]) == 1
+
+    def test_multi_segment_key_partitioned_exact(self):
+        eng, uid, url, ts = _world(seed=13, n_segments=3, partition_by_key=True)
+        counts, complete, maxstep = _expected(_oracle_reach(uid, url, ts, CONDS), 4)
+        got = eng.query(
+            f"SELECT FUNNELCOUNT({STEPS_SQL}, CORRELATEBY(uid), TIMESTAMPBY(ts)) FROM events"
+        ).rows[0][0]
+        assert got == counts
+        row = eng.query(
+            f"SELECT FUNNELCOMPLETECOUNT({STEPS_SQL}, CORRELATEBY(uid), TIMESTAMPBY(ts)), "
+            f"FUNNELMAXSTEP({STEPS_SQL}, CORRELATEBY(uid), TIMESTAMPBY(ts)) FROM events"
+        ).rows[0]
+        assert int(row[0]) == complete
+        assert int(row[1]) == maxstep
+
+    def test_multi_segment_unpartitioned_never_inflates(self):
+        """Chains spanning segments may undercount (documented) but the
+        merged result must never exceed the single-segment exact answer."""
+        eng1, uid, url, ts = _world(seed=17, n_segments=1)
+        eng3, _, _, _ = _world(seed=17, n_segments=3)
+        q = f"SELECT FUNNELCOUNT({STEPS_SQL}, CORRELATEBY(uid), TIMESTAMPBY(ts)) FROM events"
+        exact = eng1.query(q).rows[0][0]
+        merged = eng3.query(q).rows[0][0]
+        assert all(m <= e for m, e in zip(merged, exact))
+        assert merged[0] == exact[0]  # step 1 needs no ordering — always exact
+
+    def test_grouped_ordered_funnel(self):
+        eng, uid, url, ts = _world(seed=21)
+        res = eng.query(
+            "SELECT uid, FUNNELMAXSTEP(STEPS(url = '/home', url = '/product'), "
+            "CORRELATEBY(uid), TIMESTAMPBY(ts)) FROM events GROUP BY uid ORDER BY uid"
+        )
+        reach = _oracle_reach(uid, url, ts, ["/home", "/product"])
+        for u, got in res.rows:
+            assert int(got) == reach.get(u, 0), u
+
+    def test_window_without_timestampby_rejected(self):
+        from pinot_tpu.sql.parser import SqlParseError, parse_query
+
+        with pytest.raises(SqlParseError):
+            parse_query(
+                f"SELECT FUNNELCOUNT({STEPS_SQL}, CORRELATEBY(uid), 500) FROM events"
+            )

@@ -701,6 +701,61 @@ class TestW017UnfencedDispatchTiming:
         assert _rules(src) == []
 
 
+class TestW018BlockingInDispatch:
+    def test_flags_sleep_in_batcher_pump(self):
+        src = """
+        import time
+
+        class MicroBatcher:
+            def pump(self, now=None):
+                time.sleep(0.001)  # busy-wait for stragglers
+                return self._flush(now)
+        """
+        assert _rules(src, threaded=True) == ["W018"]
+
+    def test_flags_device_fence_in_dispatch_loop(self):
+        src = """
+        def broker_dispatch_loop(queue):
+            out = queue.popleft()
+            out.block_until_ready()
+        """
+        assert _rules(src, threaded=True) == ["W018"]
+
+    def test_flags_socket_wait_in_batcher_method(self):
+        src = """
+        class QueryBatcher:
+            def drain(self, sock):
+                return sock.recv(4096)
+        """
+        assert _rules(src, threaded=True) == ["W018"]
+
+    def test_quiet_on_condition_wait_and_out_of_scope_sleep(self):
+        src = """
+        import time
+
+        class MicroBatcher:
+            def pump(self, now=None):
+                with self._cv:
+                    self._cv.wait(timeout=0.01)  # sanctioned wakeup
+                return 0
+
+        def warmup():
+            time.sleep(0.5)  # not a dispatch path
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_rule_is_threaded_scope_only(self):
+        src = """
+        import time
+
+        class MicroBatcher:
+            def pump(self):
+                time.sleep(0.001)
+        """
+        assert _rules(src, threaded=False) == []
+        assert _rules(src, threaded=True) == ["W018"]
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

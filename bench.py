@@ -186,6 +186,135 @@ def _overload_bench() -> dict:
     }
 
 
+def _tail_latency_bench() -> dict:
+    """Tail-latency section (round-15 tail tolerance): one of two replicas
+    degraded to ~10x latency by a seeded FaultPlan jitter rule, measured
+    three ways — fault-free baseline, degraded without hedging, degraded
+    with hedged scatter (delay derived from the healthy peer's observed
+    p95).  Brownout deprioritization is disabled for the sweep so it
+    isolates hedging from routing-away; the brownout path has its own
+    tests.  Reports p50/p99 per leg plus the hedge rate and wasted-work %
+    — `hedged_p99_ms` is a lower-is-better metric in the `cli perf
+    --check` regression gate."""
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.coordinator import Coordinator
+    from pinot_tpu.cluster.faults import FaultPlan
+    from pinot_tpu.cluster.server import ServerInstance
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.spi.config import SegmentsConfig, TableConfig
+    from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+    from pinot_tpu.utils.metrics import METRICS
+
+    rows = int(os.environ.get("BENCH_TAIL_ROWS", 5_000))
+    n_meas = int(os.environ.get("BENCH_TAIL_QUERIES", 60))
+    slow_mult = float(os.environ.get("BENCH_TAIL_SLOW_MULT", "10"))
+
+    def make_cluster():
+        schema = Schema(
+            "t",
+            [
+                FieldSpec("city", DataType.STRING),
+                FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+                FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+            ],
+        )
+        coord = Coordinator(replication=2)
+        for i in range(2):
+            coord.register_server(ServerInstance(f"server{i}"))
+        coord.add_table(schema, TableConfig(name="t", segments=SegmentsConfig(time_column="ts")))
+        rng = np.random.default_rng(11)
+        for i in range(4):
+            coord.add_segment(
+                "t",
+                build_segment(
+                    schema,
+                    {
+                        "city": rng.choice(["sf", "nyc", "la"], rows).astype(object),
+                        "v": rng.integers(0, 100, rows),
+                        "ts": 1_700_000_000_000
+                        + rng.integers(0, 86_400_000, rows).astype(np.int64),
+                    },
+                    f"seg{i}",
+                ),
+            )
+        return coord, Broker(coord)
+
+    def sql_at(i: int) -> str:
+        # distinct literal per query: misses the result cache every time
+        return (
+            "SELECT city, COUNT(*), SUM(v) FROM t "
+            f"WHERE v < {50 + i % 40} GROUP BY city ORDER BY city"
+        )
+
+    hedge_counters = ("hedgesLaunched", "hedgeWins", "hedgesCancelled", "hedgesDenied")
+
+    def run_leg(slow_ms: float, hedge: bool) -> dict:
+        coord, broker = make_cluster()
+        if slow_ms > 0:
+            # balanced round-robin routing sends 2 of 4 segments to each
+            # server, so every query's scatter includes the slow replica
+            FaultPlan(seed=17).jitter("server0", base_ms=slow_ms, sigma=0.5).attach(coord)
+        broker.health.brownout_factor = float("inf")  # isolate hedging
+        hc = broker.hedge
+        hc.enabled_default = hedge
+        hc.budget_pct = 60.0  # 2-server scatter: 1 hedge per query = 50% of launches
+        c0 = {k: METRICS.counter(f"broker.{k}").value for k in hedge_counters}
+        w0 = (
+            METRICS.timer("broker.hedgeWastedMs").total_ms
+            + METRICS.timer("broker.hedgeCancelMs").total_ms
+        )
+        leg_t0 = time.perf_counter()
+        broker.query(sql_at(0))  # warm: parse, plan, compile
+        # fill the per-(table, server) latency windows so the hedge delay is
+        # derived from observed peer quantiles rather than an env override
+        for i in range(hc.min_samples + 2):
+            broker.query(sql_at(i))
+        ts = []
+        for i in range(n_meas):
+            t0 = time.perf_counter()
+            broker.query(sql_at(100 + i))
+            ts.append((time.perf_counter() - t0) * 1000)
+        leaked = broker.hedge_drain()
+        leg_wall_ms = (time.perf_counter() - leg_t0) * 1000
+        counts = {k: METRICS.counter(f"broker.{k}").value - c0[k] for k in hedge_counters}
+        wasted_ms = (
+            METRICS.timer("broker.hedgeWastedMs").total_ms
+            + METRICS.timer("broker.hedgeCancelMs").total_ms
+            - w0
+        )
+        snap = hc.snapshot()
+        return {
+            "p50_ms": round(float(np.percentile(ts, 50)), 3),
+            "p99_ms": round(float(np.percentile(ts, 99)), 3),
+            "hedge_rate": round(snap["hedges"] / max(1, snap["primaries"]), 4),
+            # share of all compute-ms (wall + discarded attempt time) that
+            # losing attempts burned before cooperative cancel reclaimed them
+            "wasted_work_pct": round(100.0 * wasted_ms / max(1e-9, wasted_ms + leg_wall_ms), 2),
+            "leaked_launches": leaked,
+            **{k: v for k, v in counts.items()},
+        }
+
+    fault_free = run_leg(slow_ms=0.0, hedge=False)
+    # self-calibrating fault: the slow replica's jitter base is 10x the
+    # measured fault-free median, i.e. "one replica at 10x latency"
+    slow_ms = round(slow_mult * max(0.5, fault_free["p50_ms"]), 3)
+    unhedged = run_leg(slow_ms=slow_ms, hedge=False)
+    hedged = run_leg(slow_ms=slow_ms, hedge=True)
+    ff_p99 = max(1e-9, fault_free["p99_ms"])
+    return {
+        "slow_replica_ms": slow_ms,
+        "fault_free": fault_free,
+        "unhedged": unhedged,
+        "hedged": hedged,
+        "hedge_rate": hedged["hedge_rate"],
+        "wasted_work_pct": hedged["wasted_work_pct"],
+        "p99_vs_fault_free": {
+            "unhedged_x": round(unhedged["p99_ms"] / ff_p99, 2),
+            "hedged_x": round(hedged["p99_ms"] / ff_p99, 2),
+        },
+    }
+
+
 def _concurrent_qps_bench() -> dict:
     """Sustained QPS under 100+ simultaneous clients (round-12 concurrent
     serving tier).  Two modes over identical same-fingerprint workloads
@@ -611,6 +740,7 @@ def main() -> None:
         "effective_bytes_per_sec": round(rows_per_sec * bytes_per_row, 1),
         "roofline": roofline,
         "overload": _overload_bench(),
+        "tail_latency": _tail_latency_bench(),
         "concurrent_qps": _concurrent_qps_bench(),
     }
     print(json.dumps(report))

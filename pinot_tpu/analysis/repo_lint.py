@@ -96,6 +96,19 @@ been bitten by (ADVICE r5) or that silently degrades TPU throughput:
                               wakeup and stays clean; device fences belong
                               in the submitting caller's thread
                               (Future.result) or the runner's collect.
+  W019 unbounded-retry-loop   a `while` loop in cluster/ that re-issues a
+                              server call (`.execute(...)` /
+                              `.execute_batch(...)`) either without a
+                              bounded backoff (no sleep/_sleep anywhere in
+                              the loop body) or without routing the
+                              abandoned attempt through the cancel-probe
+                              path (an execute call missing the cancel=/
+                              cancels= keyword).  A retry/hedge loop with
+                              neither is a tight retry storm whose
+                              abandoned attempts keep burning device time —
+                              the r11 cooperative-cancel contract exists
+                              precisely so a re-issued call's loser can be
+                              killed between kernels.
 
 Kernel bodies (W001/W002 scope) are functions the module jits: decorated
 with @jax.jit / @partial(jax.jit, ...) or passed by name to jax.jit(...)
@@ -130,6 +143,7 @@ RULES: Dict[str, str] = {
     "W016": "non-durable write to a durability path (no tmp-fsync-replace discipline)",
     "W017": "wall-clock timing around an async jitted dispatch without a device fence before the stop timestamp",
     "W018": "blocking call (sleep/device fence/socket I/O) inside an async batch-dispatch path",
+    "W019": "retry/hedge loop re-issues a server call without bounded backoff or without the cancel-probe path",
     # interprocedural passes (analysis/races.py, analysis/device_sync.py —
     # run via analysis/engine.py over the whole package, not per-file):
     "W010": "lock-guarded attribute read/written without holding its lock",
@@ -1135,6 +1149,53 @@ def _check_w018(path: str, tree: ast.AST, findings: List[Finding]) -> None:
                 ))
 
 
+_W019_SERVER_CALLS = frozenset({"execute", "execute_batch"})
+
+
+def _check_w019(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """W019: retry/hedge loop discipline.  A `while` loop that (re-)issues
+    server calls — `.execute(...)` / `.execute_batch(...)` — is the failover
+    or hedging shape; it must (a) bound its re-issue rate with a backoff
+    (some sleep/_sleep call inside the loop body) and (b) route every server
+    call through the cooperative-cancel contract (cancel=/cancels= keyword),
+    so an abandoned attempt can be killed between kernels instead of burning
+    device time to completion.  `for` loops are exempt: a fan-out over an
+    assignment is not a retry."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While):
+            continue
+        server_calls = []
+        has_backoff = False
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr in _W019_SERVER_CALLS:
+                server_calls.append(n)
+            if (isinstance(f, ast.Name) and f.id in ("sleep", "_sleep")) or (
+                isinstance(f, ast.Attribute) and f.attr in ("sleep", "_sleep")
+            ):
+                has_backoff = True
+        if not server_calls:
+            continue
+        if not has_backoff:
+            findings.append(Finding(
+                path, node.lineno, "W019",
+                "retry loop re-issues a server call with no bounded backoff "
+                "(no sleep/_sleep in the loop body) — a tight retry storm "
+                "under failure",
+            ))
+        for call in server_calls:
+            if not any(kw.arg in ("cancel", "cancels") for kw in call.keywords):
+                findings.append(Finding(
+                    path, call.lineno, "W019",
+                    "server call re-issued in a retry loop without cancel=/"
+                    "cancels= — the abandoned attempt can never be "
+                    "cooperatively cancelled and burns device time to "
+                    "completion",
+                ))
+
+
 def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> List[Finding]:
     """Lint one module's source.  `threaded` enables the cluster/-scoped
     rules (W004 shared-state races, W006 swallowed exceptions, W015
@@ -1169,6 +1230,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
         _check_w006(path, tree, findings)
         _check_w015(path, tree, findings)
         _check_w018(path, tree, findings)
+        _check_w019(path, tree, findings)
     suppressions = parse_suppressions(src)
     if suppressions:
         findings = [f for f in findings if not is_suppressed(f, suppressions)]

@@ -41,6 +41,7 @@ from pinot_tpu.cluster.coordinator import Coordinator
 from pinot_tpu.cluster.server import ServerInstance
 from pinot_tpu.cluster.broker import (
     Broker,
+    HedgeController,
     NoReplicaAvailableError,
     ScatterGatherError,
     ServerHealth,
@@ -55,6 +56,7 @@ __all__ = [
     "Coordinator",
     "ServerInstance",
     "Broker",
+    "HedgeController",
     "ServerHealth",
     "FaultPlan",
     "ServerFaultError",

@@ -235,6 +235,21 @@ class AdmissionController:
                 self._waiting -= 1
                 METRICS.gauge("admission.queuedQueries").set(float(self._waiting))
 
+    def try_charge(self, units: float = 1.0) -> bool:
+        """Non-blocking charge for OPTIONAL work (hedged backups): take
+        `units` only if available right now, never queue, never shed.  Under
+        token scarcity this returns False while admit() can still queue —
+        exactly the ordering that throttles hedges before primaries."""
+        if self.rate <= 0:
+            return True
+        units = min(float(units), self.burst)
+        with self._lock:
+            self._refill_locked(self.clock())
+            if self._tokens >= units:
+                self._tokens -= units
+                return True
+            return False
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             self._refill_locked(self.clock())
@@ -720,6 +735,13 @@ class ResourceGovernor:
 
     def cancel_probe(self, query_id: str) -> Callable[[], Optional[str]]:
         return self.watchdog.cancel_probe(query_id)
+
+    def try_charge_hedge(self, units: float = 1.0) -> bool:
+        """Non-blocking token charge for a hedged backup launch.  A hedge is
+        strictly optional work, so it may only take tokens that are free
+        RIGHT NOW — it never queues, never sheds, and under pressure loses
+        to primaries (which can still wait for refill)."""
+        return self.admission.try_charge(units)
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-ready state behind GET /debug/admission + `cli admission`."""

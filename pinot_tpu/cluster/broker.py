@@ -16,9 +16,12 @@ selection — matches the reference contracts.
 from __future__ import annotations
 
 import itertools
+import queue
 import random
+import statistics
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 from pinot_tpu.cluster.admission import (
@@ -149,9 +152,21 @@ class ServerHealth:
 
     Quarantine is advisory, never availability-destroying: when every
     replica of a segment is quarantined the router still uses them (serving
-    a maybe-flaky replica beats failing the query outright)."""
+    a maybe-flaky replica beats failing the query outright).
+
+    Orthogonal to the breaker, a BROWNOUT state tracks gray failure (slow
+    but alive — the breaker never sees an error): each server keeps a
+    rolling window of observed scatter latencies, and a server whose window
+    median is `brownout_factor`x the median of its peers' medians enters
+    brownout.  Browned servers stay available() — the router only WEIGHTS
+    them away (prefers non-browned candidates), so availability never
+    drops.  Recovery mirrors the half-open probe: once `brownout_cooldown_s`
+    elapses the deprioritization lifts, probe traffic flows, and the next
+    latency evaluation either clears the brownout or re-stamps it."""
 
     def __init__(self, failure_threshold: int = 3, cooldown_s: float = 30.0):
+        import os
+
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
         self.clock = time.monotonic  # injectable for deterministic tests
@@ -159,6 +174,17 @@ class ServerHealth:
         self._consecutive: Dict[str, int] = {}
         self._opened_at: Dict[str, float] = {}  # server -> quarantine start
         self._probing: Set[str] = set()  # half-open probes in flight
+        # -- gray-failure (brownout) detection --------------------------------
+        self.brownout_factor = float(os.environ.get("PINOT_TPU_BROWNOUT_FACTOR", "3.0"))
+        self.brownout_min_samples = int(os.environ.get("PINOT_TPU_BROWNOUT_MIN_SAMPLES", "8"))
+        self.brownout_cooldown_s = float(
+            os.environ.get("PINOT_TPU_BROWNOUT_COOLDOWN_S", str(cooldown_s))
+        )
+        # absolute floor: sub-floor medians never brown a server, so noise on
+        # microsecond-scale test queries can't trigger spurious routing shifts
+        self.brownout_min_ms = float(os.environ.get("PINOT_TPU_BROWNOUT_MIN_MS", "2.0"))
+        self._latency: Dict[str, "deque"] = {}  # rolling per-server windows
+        self._browned: Dict[str, float] = {}  # server -> brownout start
 
     def record_failure(self, server: str) -> None:
         with self._lock:
@@ -188,12 +214,81 @@ class ServerHealth:
         METRICS.gauge(f"broker.breakerOpen.{server}").set(
             1.0 if server in self._opened_at else 0.0
         )
+        METRICS.gauge("broker.brownouts").set(len(self._browned))
+        METRICS.gauge(f"broker.brownout.{server}").set(
+            1.0 if server in self._browned else 0.0
+        )
+
+    def note_latency(self, server: str, latency_ms: float) -> Optional[str]:
+        """Feed one observed scatter latency and re-evaluate brownout for the
+        server.  Returns "enter"/"exit" on a brownout transition, else None.
+        This is the ONLY path that moves brownout state — record_failure /
+        record_success never touch it, keeping breaker and brownout fully
+        independent (a browned server can trip its breaker and vice versa)."""
+        with self._lock:
+            win = self._latency.get(server)
+            if win is None:
+                win = self._latency[server] = deque(maxlen=32)
+            win.append(float(latency_ms))
+            return self._evaluate_brownout_locked(server)
+
+    def _evaluate_brownout_locked(self, server: str) -> Optional[str]:
+        win = self._latency.get(server)
+        if win is None or len(win) < self.brownout_min_samples:
+            return None
+        peer_medians = [
+            statistics.median(w)
+            for s, w in self._latency.items()
+            if s != server and len(w) >= self.brownout_min_samples
+        ]
+        if not peer_medians:
+            return None  # outlier-vs-peers needs at least one mature peer
+        own = statistics.median(win)
+        peers = statistics.median(peer_medians)
+        browned_at = self._browned.get(server)
+        is_outlier = own >= self.brownout_min_ms and own > self.brownout_factor * peers
+        now = self.clock()
+        if is_outlier:
+            if browned_at is None:
+                self._browned[server] = now
+                METRICS.counter("broker.serversBrownedOut").inc()
+                self._publish_gauges_locked(server)
+                return "enter"
+            if now - browned_at >= self.brownout_cooldown_s:
+                # the half-open-style probe still looks slow: re-stamp the
+                # cooldown, exactly like a failed breaker probe re-opens
+                self._browned[server] = now
+            return None
+        if browned_at is not None and now - browned_at >= self.brownout_cooldown_s:
+            # probe traffic after the cooldown came back at peer speed
+            del self._browned[server]
+            METRICS.counter("broker.brownoutRecoveries").inc()
+            self._publish_gauges_locked(server)
+            return "exit"
+        return None
+
+    def in_brownout(self, server: str) -> bool:
+        with self._lock:
+            return server in self._browned
+
+    def brownout_deprioritized(self, server: str) -> bool:
+        """Should the router weight this server away right now?  True while
+        browned and inside the cooldown; after the cooldown the server takes
+        normal traffic again (the probe window) until note_latency clears or
+        re-stamps the brownout."""
+        with self._lock:
+            t = self._browned.get(server)
+            return t is not None and self.clock() - t < self.brownout_cooldown_s
+
+    def latency_window(self, server: str) -> List[float]:
+        with self._lock:
+            return list(self._latency.get(server, ()))
 
     def state(self, server: str) -> str:
         with self._lock:
             t = self._opened_at.get(server)
             if t is None:
-                return "closed"
+                return "brownout" if server in self._browned else "closed"
             return "half_open" if self.clock() - t >= self.cooldown_s else "open"
 
     def available(self, server: str) -> bool:
@@ -224,7 +319,109 @@ class ServerHealth:
             self._consecutive.pop(server, None)
             self._opened_at.pop(server, None)
             self._probing.discard(server)
+            self._browned.pop(server, None)
+            self._latency.pop(server, None)
             self._publish_gauges_locked(server)
+
+
+def _p95(values) -> float:
+    xs = sorted(values)
+    return xs[min(len(xs) - 1, int(round(0.95 * (len(xs) - 1))))]
+
+
+class HedgeController:
+    """Policy + bookkeeping for hedged scatter calls (the tail-tolerance
+    half of "The Tail at Scale"): per-(table, server) rolling latency
+    windows derive the hedge delay (a multiple of the PEER replicas' p95 —
+    a chronically slow primary must not inflate its own trigger), and a
+    launch budget caps hedges at `budget_pct`% of primary launches so
+    hedging can never amplify an overload.  Hedging is opt-in: the
+    PINOT_TPU_HEDGE env toggle or the per-query `hedge` option.
+
+    Env knobs: PINOT_TPU_HEDGE (enable), PINOT_TPU_HEDGE_DELAY_MS (flat
+    delay override, skips the quantile derivation), PINOT_TPU_HEDGE_BUDGET_PCT
+    (default 10), PINOT_TPU_HEDGE_MIN_SAMPLES (default 8),
+    PINOT_TPU_HEDGE_QUANTILE_MULT (default 1.0), PINOT_TPU_HEDGE_MIN_DELAY_MS
+    (default 1.0).  Query options `hedge`, `hedgeDelayMs`, `hedgeBudgetPct`
+    override per query."""
+
+    WINDOW = 64
+
+    def __init__(self) -> None:
+        import os
+
+        env = os.environ
+        self.enabled_default = env.get("PINOT_TPU_HEDGE", "0").lower() in ("1", "true", "yes")
+        d = env.get("PINOT_TPU_HEDGE_DELAY_MS")
+        self.env_delay_ms: Optional[float] = float(d) if d else None
+        self.budget_pct = float(env.get("PINOT_TPU_HEDGE_BUDGET_PCT", "10"))
+        self.min_samples = int(env.get("PINOT_TPU_HEDGE_MIN_SAMPLES", "8"))
+        self.quantile_mult = float(env.get("PINOT_TPU_HEDGE_QUANTILE_MULT", "1.0"))
+        self.min_delay_ms = float(env.get("PINOT_TPU_HEDGE_MIN_DELAY_MS", "1.0"))
+        self._lock = threading.Lock()
+        self._windows: Dict[Tuple[str, str], deque] = {}
+        self._primaries = 0
+        self._hedges = 0
+
+    def enabled(self, opts: Optional[Dict] = None) -> bool:
+        if opts is not None and "hedge" in opts:
+            return str(opts.get("hedge", "")).lower() in ("1", "true", "yes")
+        return self.enabled_default
+
+    def observe(self, table: str, server: str, latency_ms: float) -> None:
+        with self._lock:
+            key = (table, server)
+            win = self._windows.get(key)
+            if win is None:
+                win = self._windows[key] = deque(maxlen=self.WINDOW)
+            win.append(float(latency_ms))
+
+    def delay_ms(self, table: str, primary: str, opts: Optional[Dict] = None) -> Optional[float]:
+        """Hedge trigger delay for a call routed to `primary`, or None when
+        there is not yet enough signal to hedge safely (cold start)."""
+        if opts is not None and opts.get("hedgeDelayMs") is not None:
+            return float(opts["hedgeDelayMs"])
+        if self.env_delay_ms is not None:
+            return self.env_delay_ms
+        with self._lock:
+            peer_p95s = [
+                _p95(win)
+                for (t, s), win in self._windows.items()
+                if t == table and s != primary and len(win) >= self.min_samples
+            ]
+        if not peer_p95s:
+            return None
+        return max(self.min_delay_ms, self.quantile_mult * statistics.median(peer_p95s))
+
+    def note_primary(self) -> None:
+        with self._lock:
+            self._primaries += 1
+
+    def try_fire(self, opts: Optional[Dict] = None) -> bool:
+        """Claim one hedge launch against the budget; False when the next
+        hedge would push the hedge:primary ratio past budget_pct%."""
+        pct = self.budget_pct
+        if opts is not None and opts.get("hedgeBudgetPct") is not None:
+            pct = float(opts["hedgeBudgetPct"])
+        with self._lock:
+            if (self._hedges + 1) > pct / 100.0 * self._primaries:
+                return False
+            self._hedges += 1
+            return True
+
+    def unfire(self) -> None:
+        """Return a claimed launch (admission refused the charge)."""
+        with self._lock:
+            self._hedges = max(0, self._hedges - 1)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "primaries": self._primaries,
+                "hedges": self._hedges,
+                "budgetPct": self.budget_pct,
+                "windows": len(self._windows),
+            }
 
 
 class _BatchMember:
@@ -282,6 +479,12 @@ class Broker:
         # are deterministic and never wall-clock sensitive
         self.retry_rng = random.Random(0x5CA77E12)
         self._sleep = time.sleep
+        # tail tolerance: hedged-scatter policy + live loser threads (each
+        # loser is cooperatively cancelled via the cancel-probe path and
+        # tracked here until it unwinds — hedge_drain() proves no leaks)
+        self.hedge = HedgeController()
+        self._hedge_threads: Set[threading.Thread] = set()
+        self._hedge_lock = threading.Lock()
         # query-id mint: itertools.count is atomic under the GIL, so handler
         # threads never need a lock for the sequence (W004-clean by design)
         self._qid_seq = itertools.count(1)
@@ -411,6 +614,12 @@ class Broker:
                     unroutable.append(seg)
                     continue
                 raise NoReplicaAvailableError(f"segment {table}/{seg} has no live replica")
+            # gray-failure weighting: prefer non-browned replicas, but a
+            # fully-browned candidate set still serves (availability wins,
+            # exactly like breaker quarantine above)
+            bright = [c for c in candidates if not self.health.brownout_deprioritized(c)]
+            if bright:
+                candidates = bright
             if self.selector == "adaptive":
                 # latency-biased: best (lowest) score wins; round-robin
                 # breaks exact ties so cold starts still spread
@@ -1070,6 +1279,261 @@ class Broker:
             METRICS.counter("broker.memberServeErrors").inc()
             return e
 
+    # -- hedged execution (tail tolerance) ---------------------------------
+    @staticmethod
+    def _compose_cancel(base, lost_evt):
+        """Per-attempt cancel probe for a hedged call: the outer watchdog
+        probe (if any) keeps priority; once the sibling attempt wins, the
+        probe returns "hedge_lost" and the loser abandons its pending
+        launches through the SAME cooperative path a watchdog kill uses
+        (ServerInstance._check_budget between kernels)."""
+
+        def probe():
+            if base is not None:
+                r = base()
+                if r:
+                    return r
+            if lost_evt.is_set():
+                return "hedge_lost"
+            return None
+
+        return probe
+
+    def _hedge_target(
+        self, table: str, segs: List[str], primary: str, exclude: frozenset
+    ) -> Optional[str]:
+        """Best alternative replica serving ALL of the primary's segments:
+        live, breaker-available, not the primary, not excluded this scatter.
+        Non-browned closed-breaker replicas rank first, then adaptive score —
+        hedging onto a gray server would just move the tail."""
+        view = self.coordinator.external_view(table)
+        candidates: Optional[Set[str]] = None
+        for seg in segs:
+            replicas = view.get(seg, set())
+            candidates = set(replicas) if candidates is None else (candidates & replicas)
+            if not candidates:
+                return None
+        if not candidates:
+            return None
+        candidates = {
+            s
+            for s in candidates
+            if s != primary
+            and s not in exclude
+            and s in self.coordinator.live
+            and self.health.available(s)
+        }
+        if not candidates:
+            return None
+        return min(
+            candidates,
+            key=lambda s: (
+                self.health.brownout_deprioritized(s),
+                self.health.state(s) != "closed",
+                self.server_stats.score(s),
+                s,
+            ),
+        )
+
+    def hedge_drain(self, timeout_s: float = 5.0) -> int:
+        """Join every outstanding hedge attempt thread; returns how many are
+        STILL alive after the timeout (tests assert 0 — no leaked launches)."""
+        with self._hedge_lock:
+            threads = list(self._hedge_threads)
+        dl = time.monotonic() + timeout_s
+        alive = 0
+        for t in threads:
+            t.join(timeout=max(0.0, dl - time.monotonic()))
+            if t.is_alive():
+                alive += 1
+        return alive
+
+    def _account_loser(
+        self, name: str, ok: bool, out, ms: float, table: str, stats, batch: bool = False
+    ) -> None:
+        """Settle the attempt that did NOT win a hedged call — accounting
+        happens here EXACTLY once (the winner path never sees the loser).
+        Runs on the loser's own thread (or the caller's, for a failure that
+        arrived before the winner)."""
+        if ok and batch:
+            # a losing execute_batch returns normally with each member
+            # detached via its probe: all-members hedge_lost == cancelled
+            errs = out[2]
+            if errs and all(
+                isinstance(e, QueryKilledError) and getattr(e, "reason", None) == "hedge_lost"
+                for e in errs
+            ):
+                METRICS.counter("broker.hedgesCancelled").inc()
+                METRICS.timer("broker.hedgeCancelMs").update(ms)
+                return
+        if ok:
+            # the loser finished anyway (too late to matter): its latency is
+            # real signal, its work is the hedge's waste
+            self.health.record_success(name)
+            self.health.note_latency(name, ms)
+            self.hedge.observe(table, name, ms)
+            METRICS.timer("broker.hedgeWastedMs").update(ms)
+            return
+        e = out
+        if isinstance(e, QueryKilledError) and e.reason == "hedge_lost":
+            # cooperative cancel landed: not a failure — no punish, breaker
+            # untouched (mirrors the watchdog-kill taxonomy in _scatter)
+            METRICS.counter("broker.hedgesCancelled").inc()
+            METRICS.timer("broker.hedgeCancelMs").update(ms)
+            if stats is not None:
+                stats.hedge_cancelled_ms = ms  # best-effort slowlog surface
+            return
+        if isinstance(e, QueryKilledError):
+            return  # outer watchdog kill: canonical accounting rides the winner path
+        if isinstance(e, ReservationError):
+            METRICS.counter("broker.scatterCapacityRejections").inc()
+            return
+        # genuine fault on the losing attempt: punish/breaker exactly once,
+        # here (its segments were served by the winner — no failover needed)
+        self.server_stats.punish(name)
+        self.health.record_failure(name)
+        METRICS.counter("broker.scatterServerFailures").inc()
+
+    def _hedged_call(
+        self,
+        table: str,
+        primary: str,
+        run,
+        *,
+        opts: Optional[Dict] = None,
+        segs: List[str] = (),
+        exclude: frozenset = frozenset(),
+        stats=None,
+        batch: bool = False,
+    ):
+        """Run ``run(server, lost_event)`` on `primary`, hedging a backup
+        replica when the quantile-derived delay elapses without a reply.
+        Returns ``(winner, payload, winner_ms, info)``.
+
+        Engagement is decided up front: hedging must be enabled (env/option),
+        a delay must be derivable (enough peer samples or an override), a
+        spare replica must cover the segments, and firing must clear both
+        the hedge budget and a non-blocking admission charge — otherwise the
+        call runs inline on the caller's thread exactly like the unhedged
+        scatter path (no threads, no behavior change).
+
+        First SUCCESS wins; the loser is cancelled through its cancel probe
+        and settles itself via _account_loser.  A failure that arrives while
+        the sibling is still in flight is held: if the sibling succeeds it
+        becomes the winner (the failure is side-accounted exactly once); if
+        both fail the PRIMARY's error propagates so the outer failover arms
+        attribute it to the routed server exactly as before."""
+        hc = self.hedge
+        hc.note_primary()
+        info: Dict = {"hedged": False, "winner": None, "delay_ms": None, "hedge_server": None}
+        delay = None
+        target = None
+        if hc.enabled(opts):
+            delay = hc.delay_ms(table, primary, opts)
+            if delay is not None:
+                target = self._hedge_target(table, segs, primary, exclude)
+        if delay is None or target is None:
+            # inline fast path: identical to the pre-hedge scatter call
+            self.server_stats.begin(primary)
+            st0 = time.perf_counter()
+            try:
+                payload = run(primary, None)
+            except Exception:
+                self.server_stats.end(primary, (time.perf_counter() - st0) * 1000)
+                raise
+            ms = (time.perf_counter() - st0) * 1000
+            self.server_stats.end(primary, ms)
+            hc.observe(table, primary, ms)
+            return primary, payload, ms, info
+
+        result_q: "queue.Queue" = queue.Queue()
+        slock = threading.Lock()
+        state: Dict[str, Optional[str]] = {"winner": None}
+        lost = {primary: threading.Event(), target: threading.Event()}
+
+        def attempt(name: str) -> None:
+            try:
+                self.server_stats.begin(name)
+                st0 = time.perf_counter()
+                try:
+                    out, ok = run(name, lost[name]), True
+                # not swallowed: the captured exception is triaged by the
+                # consumer (winner path raises it, loser path accounts it)
+                except Exception as e:  # pinot-lint: disable=W006
+                    out, ok = e, False
+                ms = (time.perf_counter() - st0) * 1000
+                self.server_stats.end(name, ms)
+                with slock:
+                    if state["winner"] is None:
+                        if ok:
+                            state["winner"] = name
+                        result_q.put((name, ok, out, ms))
+                        return
+                # a sibling already won: this attempt lost — settle off-path
+                self._account_loser(name, ok, out, ms, table, stats, batch=batch)
+            finally:
+                with self._hedge_lock:
+                    self._hedge_threads.discard(threading.current_thread())
+
+        def spawn(name: str, role: str) -> None:
+            t = threading.Thread(
+                target=attempt, args=(name,), daemon=True, name=f"hedge-{role}-{name}"
+            )
+            with self._hedge_lock:
+                self._hedge_threads.add(t)
+            t.start()
+
+        spawn(primary, "primary")
+        hedge_fired = False
+        try:
+            first = result_q.get(timeout=delay / 1000.0)
+        except queue.Empty:
+            first = None
+            # primary is past the derived delay: fire the backup if the
+            # hedge budget AND a non-blocking admission charge both clear
+            denied = None
+            if not hc.try_fire(opts):
+                denied = "budget"
+            elif self.governor is not None and not self.governor.try_charge_hedge(1.0):
+                hc.unfire()
+                denied = "admission"
+            if denied is None:
+                hedge_fired = True
+                info.update(hedged=True, delay_ms=delay, hedge_server=target)
+                METRICS.counter("broker.hedgesLaunched").inc()
+                spawn(target, "backup")
+            else:
+                info["denied"] = denied
+                METRICS.counter("broker.hedgesDenied").inc()
+        if first is None:
+            first = result_q.get()
+        name, ok, out, ms = first
+        if not ok and hedge_fired:
+            # one attempt failed while its sibling is still running: the
+            # sibling IS the retry — hold the error until it reports
+            name2, ok2, out2, ms2 = result_q.get()
+            if ok2:
+                self._account_loser(name, False, out, ms, table, stats, batch=batch)
+                name, ok, out, ms = name2, True, out2, ms2
+            else:
+                # both failed: side-account the backup, raise the primary's
+                # error so the outer taxonomy keys on the routed server
+                prim_err, hedge_err = (out, out2) if name == primary else (out2, out)
+                hedge_ms = ms2 if name == primary else ms
+                self._account_loser(target, False, hedge_err, hedge_ms, table, stats, batch=batch)
+                raise prim_err
+        if not ok:
+            raise out  # no hedge in flight: identical to the inline path
+        winner = name
+        for other, evt in lost.items():
+            if other != winner:
+                evt.set()
+        info["winner"] = winner
+        hc.observe(table, winner, ms)
+        if hedge_fired and winner == target:
+            METRICS.counter("broker.hedgeWins").inc()
+        return winner, out, ms, info
+
     def _scatter_batch(self, group: List, table: str, seg_names: List[str], meta, batch_id: str):
         """Failover-free batched scatter: route ONCE for the whole
         sub-group, run server.execute_batch per routed server (one vmapped
@@ -1096,24 +1560,34 @@ class Broker:
         METRICS.gauge("broker.inFlightScatters").add(1)
         try:
             for server_name, segs in assign.items():
-                server = self.coordinator.servers[server_name]
                 queried += 1
-                self.server_stats.begin(server_name)
-                st0 = time.perf_counter()
-                try:
-                    res, sstats, errs, btrace = server.execute_batch(
+
+                def run_batch(name, lost_evt, _segs=segs):
+                    srv = self.coordinator.servers[name]
+                    # per-member isolation survives hedging: each member's own
+                    # watchdog probe keeps priority inside the composed probe
+                    comp = (
+                        [m.cancel for m in group]
+                        if lost_evt is None
+                        else [self._compose_cancel(m.cancel, lost_evt) for m in group]
+                    )
+                    return srv.execute_batch(
                         [m.offline_ctx for m in group],
-                        segs,
+                        _segs,
                         table_schema=meta.schema,
                         deadlines=per_call,
-                        cancels=[m.cancel for m in group],
+                        cancels=comp,
                         batch_id=batch_id,
                         trace_enabled=trace_on,
                     )
-                except Exception as e:
-                    self.server_stats.end(
-                        server_name, (time.perf_counter() - st0) * 1000
+
+                try:
+                    winner, _payload, win_ms, hinfo = self._hedged_call(
+                        table, server_name, run_batch,
+                        opts=group[0].ctx.options, segs=segs, batch=True,
                     )
+                    res, sstats, errs, btrace = _payload
+                except Exception as e:
                     if not isinstance(e, ReservationError):
                         # genuine fault: breaker + adaptive stats learn it so
                         # the per-member fallback routes around this server
@@ -1123,8 +1597,17 @@ class Broker:
                     else:
                         METRICS.counter("broker.scatterCapacityRejections").inc()
                     raise
-                self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
-                self.health.record_success(server_name)
+                self.health.record_success(winner)
+                transition = self.health.note_latency(winner, win_ms)
+                if hinfo["hedged"] or transition is not None:
+                    for st in stats:
+                        if hinfo["hedged"]:
+                            st.hedged += 1
+                            st.hedge_winner = winner
+                        if transition is not None:
+                            st.brownout_events.append(f"{transition}:{winner}")
+                if hinfo["hedged"]:
+                    queried += 1
                 responded += 1
                 for i in range(n):
                     if errs[i] is not None:
@@ -1228,26 +1711,35 @@ class Broker:
                     failed: List[str] = []
                     for server_name, segs in assign.items():
                         deadline.check(f"query on {table}")
-                        server = self.coordinator.servers[server_name]
                         queried.add(server_name)
                         probe = self.health.state(server_name) == "half_open"
                         self.health.begin_probe(server_name)  # no-op unless half-open
                         per_call = deadline.bounded(
                             float(server_timeout_ms) if server_timeout_ms is not None else None
                         )
-                        self.server_stats.begin(server_name)
-                        st0 = time.perf_counter()
+
+                        def run_one(name, lost_evt, _segs=segs, _per_call=per_call):
+                            srv = self.coordinator.servers[name]
+                            comp = (
+                                cancel if lost_evt is None
+                                else self._compose_cancel(cancel, lost_evt)
+                            )
+                            return srv.execute(
+                                ctx, _segs, table_schema=meta.schema,
+                                deadline=_per_call, cancel=comp,
+                            )
+
                         with trace.span(
                             "server_execute", server=server_name, segments=len(segs),
                             round=rounds, probe=probe,
                         ) as ssp:
                             try:
-                                res, sstats = server.execute(
-                                    ctx, segs, table_schema=meta.schema, deadline=per_call,
-                                    cancel=cancel,
+                                winner, payload, win_ms, hinfo = self._hedged_call(
+                                    table, server_name, run_one, opts=opts, segs=segs,
+                                    exclude=frozenset(excluded), stats=stats,
                                 )
+                                res, sstats = payload
                             except Exception as e:  # noqa: BLE001 — every fault is recorded below
-                                self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
                                 if isinstance(e, QueryTimeoutError) and deadline.expired():
                                     raise  # the QUERY is out of budget, not just this server
                                 if isinstance(e, QueryKilledError):
@@ -1314,9 +1806,25 @@ class Broker:
                                         breaker=self.health.state(server_name),
                                     )
                                 continue
-                            self.server_stats.end(server_name, (time.perf_counter() - st0) * 1000)
-                            self.health.record_success(server_name)
-                            responded.add(server_name)
+                            # the winner may be the hedged backup, not the
+                            # routed primary: success accounting keys on it
+                            self.health.record_success(winner)
+                            transition = self.health.note_latency(winner, win_ms)
+                            if transition is not None:
+                                stats.brownout_events.append(f"{transition}:{winner}")
+                                if ssp is not None:
+                                    ssp.annotate(brownout=f"{transition}:{winner}")
+                            if hinfo["hedged"]:
+                                stats.hedged += 1
+                                stats.hedge_winner = winner
+                                queried.add(hinfo["hedge_server"])
+                                if ssp is not None:
+                                    ssp.annotate(
+                                        hedged=True,
+                                        winner=winner,
+                                        hedgeDelayMs=round(hinfo["delay_ms"], 3),
+                                    )
+                            responded.add(winner)
                             results.extend(res)
                             stats.num_segments_queried += sstats.num_segments_queried
                             stats.num_segments_processed += sstats.num_segments_processed

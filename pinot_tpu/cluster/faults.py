@@ -18,6 +18,14 @@ append, snapshot compaction, deep-store upload/download, rebalance move —
 so crash-recovery tests can die at EXACTLY one protocol step and assert
 the restart converges to committed state.
 
+Gray failures get first-class rules too: jitter() draws seeded lognormal
+per-call delays (keyed on (seed, server, call) so thread interleaving can't
+change the sequence), slow_ramp() degrades latency linearly toward a cap,
+gray_flap() alternates slow/fast phases, and partition(src, dst) drops
+src->dst calls one-way while dst->src keeps working.  All delays go through
+the injectable `plan.sleep`, so tier-1 tests swap in a fake clock and never
+block.
+
 Determinism contract: the same plan (same seed, same builder calls) applied
 to an identically-built cluster produces the same fault sequence, hence the
 same BrokerResponse — asserted by tests/test_fault_tolerance.py.
@@ -38,18 +46,34 @@ class ServerFaultError(RuntimeError):
 
 @dataclass
 class _Rule:
-    kind: str  # "fail" | "latency" | "flap_down" | "flap_up" | "crash" | "restart"
+    kind: str  # "fail" | "latency" | "jitter" | "slow_ramp" | "gray_flap" | "partition" | "flap_down" | "flap_up" | "crash" | "restart"
     trigger: str  # server whose call counter drives the rule
     target: str  # server the effect applies to (== trigger for fail/latency)
     calls: Optional[Set[int]] = None  # 1-based call numbers; None = every call
     ms: float = 0.0
     message: str = ""
+    sigma: float = 0.0  # lognormal shape for "jitter"
+    cap_ms: float = 0.0  # latency ceiling for "jitter"/"slow_ramp" (0 = none)
+    period: int = 0  # phase length in calls for "gray_flap"
+    source: Optional[str] = None  # caller that the "partition" rule drops
+    start_call: int = 1  # first call a "slow_ramp" counts from
 
 
 # fail/crash raise (crash of the trigger itself), so side-effecting rules on
 # the same call apply first; restarts precede crashes so a restart+crash pair
 # scheduled on one call nets out to "bounced then died" deterministically
-_APPLY_ORDER = {"latency": 0, "restart": 1, "flap_down": 2, "flap_up": 2, "crash": 3, "fail": 4}
+_APPLY_ORDER = {
+    "latency": 0,
+    "jitter": 0,
+    "slow_ramp": 0,
+    "gray_flap": 0,
+    "restart": 1,
+    "flap_down": 2,
+    "flap_up": 2,
+    "crash": 3,
+    "partition": 4,
+    "fail": 4,
+}
 
 
 class FaultPlan:
@@ -93,6 +117,51 @@ class FaultPlan:
         on_call is None) — the slow-replica / network-delay fault."""
         calls = None if on_call is None else {on_call}
         self._rules.append(_Rule("latency", server, server, calls=calls, ms=ms))
+        return self
+
+    def jitter(self, server: str, base_ms: float, sigma: float = 0.5, cap_ms: float = 0.0) -> "FaultPlan":
+        """Seeded lognormal latency jitter on every call: the per-call delay is
+        ``base_ms * lognormvariate(0, sigma)`` drawn from a generator keyed on
+        (plan seed, server, call number), so the sequence is bit-identical
+        across runs AND independent of thread interleaving — call N always
+        draws the same delay no matter which worker reaches it first."""
+        # plan builder (test-authored, bounded), not a serving path
+        self._rules.append(  # pinot-lint: disable=W015
+            _Rule("jitter", server, server, ms=base_ms, sigma=sigma, cap_ms=cap_ms)
+        )
+        return self
+
+    def slow_ramp(self, server: str, ms_per_call: float, cap_ms: float, from_call: int = 1) -> "FaultPlan":
+        """Gray degradation: latency grows linearly with each call —
+        ``min(cap_ms, ms_per_call * calls_since_start)`` — modeling a server
+        that is slowly dying (GC spiral, disk filling) without ever erroring."""
+        # plan builder (test-authored, bounded), not a serving path
+        self._rules.append(  # pinot-lint: disable=W015
+            _Rule("slow_ramp", server, server, ms=ms_per_call, cap_ms=cap_ms, start_call=from_call)
+        )
+        return self
+
+    def gray_flap(self, server: str, slow_ms: float, period: int = 4) -> "FaultPlan":
+        """Gray flapping: the server alternates between a slow phase and a
+        fast phase every `period` calls, starting slow — the hardest case for
+        breakers (never errors) and for naive outlier detection (recovers
+        just long enough to look healthy)."""
+        # plan builder (test-authored, bounded), not a serving path
+        self._rules.append(  # pinot-lint: disable=W015
+            _Rule("gray_flap", server, server, ms=slow_ms, period=max(1, period))
+        )
+        return self
+
+    def partition(self, src: str, dst: str, on_call: Optional[int] = None) -> "FaultPlan":
+        """One-way network partition: calls FROM `src` TO `dst` drop with
+        ServerFaultError while dst→src (and everyone else→dst) still works.
+        The caller identity arrives via on_execute(..., source=...); the
+        broker's scatter path identifies itself as source="broker"."""
+        calls = None if on_call is None else {on_call}
+        # plan builder (test-authored, bounded), not a serving path
+        self._rules.append(  # pinot-lint: disable=W015
+            _Rule("partition", dst, dst, calls=calls, source=src)
+        )
         return self
 
     def drop_segment(self, server: str, table: str, segment: str) -> "FaultPlan":
@@ -158,22 +227,55 @@ class FaultPlan:
                 self._rules.append(_Rule("fail", s, s, calls=bad, message="chaos"))
         return self
 
+    # -- deterministic draws ----------------------------------------------
+    def _jitter_ms(self, rule: _Rule, server: str, n: int) -> float:
+        """Lognormal delay for call `n`, keyed on (seed, server, n) through a
+        throwaway generator (random.Random seeds strings via SHA-512, stable
+        across processes) so concurrent servers can't perturb each other's
+        draw order — the fault sequence stays bit-deterministic."""
+        draw = random.Random(f"jitter:{self.seed}:{server}:{n}")
+        ms = rule.ms * draw.lognormvariate(0.0, rule.sigma)
+        if rule.cap_ms > 0:
+            ms = min(ms, rule.cap_ms)
+        return ms
+
     # -- runtime hooks (called from ServerInstance.execute) ----------------
-    def on_execute(self, server_name: str) -> None:
+    def on_execute(self, server_name: str, source: str = "broker") -> None:
         with self._lock:
             n = self._calls[server_name] = self._calls.get(server_name, 0) + 1
             due = [
                 r
                 for r in self._rules
-                if r.trigger == server_name and (r.calls is None or n in r.calls)
+                if r.trigger == server_name
+                and (r.calls is None or n in r.calls)
+                and (r.kind != "partition" or r.source == source)
             ]
         for r in sorted(due, key=lambda r: _APPLY_ORDER[r.kind]):
+            detail = r.target
+            if r.kind == "jitter":
+                detail = round(self._jitter_ms(r, server_name, n), 6)
+            elif r.kind == "slow_ramp":
+                if n < r.start_call:
+                    continue
+                detail = min(r.cap_ms, r.ms * (n - r.start_call + 1))
+            elif r.kind == "gray_flap":
+                if ((n - 1) // r.period) % 2 != 0:
+                    continue  # fast phase: no effect, no log entry
+                detail = r.ms
+            elif r.kind == "partition":
+                detail = r.source
             # the fault ledger IS the harness product (tests slice it by
             # index); a deque can't slice, and plans live one test long
             with self._lock:
-                self.log.append((server_name, n, r.kind, r.target))  # pinot-lint: disable=W015
+                self.log.append((server_name, n, r.kind, detail))  # pinot-lint: disable=W015
             if r.kind == "latency":
                 self.sleep(r.ms / 1000.0)
+            elif r.kind in ("jitter", "slow_ramp", "gray_flap"):
+                self.sleep(detail / 1000.0)
+            elif r.kind == "partition":
+                raise ServerFaultError(
+                    f"injected partition: {r.source}->{server_name} dropped (call {n})"
+                )
             elif r.kind == "flap_down" and self._coordinator is not None:
                 self._coordinator.mark_down(r.target)
             elif r.kind == "flap_up" and self._coordinator is not None:

@@ -126,6 +126,7 @@ class ServerInstance:
         table_schema=None,
         deadline: Optional[Deadline] = None,
         cancel=None,
+        source: str = "broker",
     ):
         """Run one query over the named LOCAL segments; returns
         (segment results, stats) — the DataTable the reference ships back.
@@ -170,7 +171,9 @@ class ServerInstance:
             plan = self.fault_plan
             if plan is not None:
                 fault_n0 = len(plan.log)
-                plan.on_execute(self.name)  # may sleep, flap liveness, or raise
+                # may sleep, flap liveness, or raise; `source` lets one-way
+                # partition rules drop only this caller's direction
+                plan.on_execute(self.name, source=source)
                 if trace.enabled and len(plan.log) > fault_n0:
                     trace.annotate(faults=[k for (_, _, k, _) in plan.log[fault_n0:]])
             stats = ExecutionStats()
@@ -267,6 +270,7 @@ class ServerInstance:
         cancels: Optional[List] = None,
         batch_id: Optional[str] = None,
         trace_enabled: bool = False,
+        source: str = "broker",
     ):
         """Run N same-shape queries over the named LOCAL segments as ONE
         vmapped launch per segment (executor.launch_segment_batch); returns
@@ -317,7 +321,7 @@ class ServerInstance:
             plan = self.fault_plan
             if plan is not None:
                 fault_n0 = len(plan.log)
-                plan.on_execute(self.name)  # may sleep, flap liveness, or raise
+                plan.on_execute(self.name, source=source)  # may sleep, flap liveness, or raise
                 if trace.enabled and len(plan.log) > fault_n0:
                     trace.annotate(faults=[k for (_, _, k, _) in plan.log[fault_n0:]])
             stats = [ExecutionStats() for _ in range(n)]

@@ -54,6 +54,15 @@ class ExecutionStats:
     # fence-bounded device-compute wall time (the device_wait span), when
     # the execution path measured one — the roofline denominator
     device_ms: float = 0.0
+    # tail-tolerance surface (hedged scatter + brownout router, r15): how
+    # many scatter calls hedged a backup, which server won the last hedged
+    # call, how long the cancelled loser ran (best-effort: the loser thread
+    # stamps it when its cooperative kill lands), and any brownout
+    # transitions ("enter:server" / "exit:server") observed this query
+    hedged: int = 0
+    hedge_winner: Optional[str] = None
+    hedge_cancelled_ms: float = 0.0
+    brownout_events: List[str] = field(default_factory=list)
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_segments_queried += other.num_segments_queried
@@ -68,6 +77,10 @@ class ExecutionStats:
         self.exceptions.extend(other.exceptions)
         self.add_index_uses(other.filter_index_uses)
         self.query_id = self.query_id or other.query_id
+        self.hedged += other.hedged
+        self.hedge_winner = other.hedge_winner or self.hedge_winner
+        self.hedge_cancelled_ms += other.hedge_cancelled_ms
+        self.brownout_events.extend(other.brownout_events)
         self.add_kernel_cost(other)
 
     def add_kernel_cost(self, other: "ExecutionStats") -> None:

@@ -402,6 +402,12 @@ GATE_METRICS: Tuple[str, ...] = (
     "batched_qps",
 )
 
+# Lower-is-better latency series: the gate fails when these RISE past the
+# allowance (drop is computed with the sign flipped).  hedged_p99_ms is the
+# tail_latency bench's hedged p99 under one 10x-degraded replica — the
+# tail-tolerance layer's whole point is keeping it near the fault-free p99.
+GATE_METRICS_LOWER: Tuple[str, ...] = ("hedged_p99_ms",)
+
 # Allowance bounds: at least 15% slack (CI-grade CPU runs are noisy even
 # with bench.py's median-of-pairs machinery), never 20%+ — the acceptance
 # bar is that a true ≥20% throughput regression always trips the gate.
@@ -416,6 +422,7 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
     sweep = report.get("distinct_literal_sweep", {}) or {}
     roofline = report.get("roofline", {}) or {}
     qps = report.get("concurrent_qps", {}) or {}
+    tail = report.get("tail_latency", {}) or {}
     return {
         "schema": 1,
         "bench": bench,
@@ -433,6 +440,9 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
             "batched_qps": (qps.get("batched", {}) or {}).get("qps"),
             "unbatched_qps": (qps.get("unbatched", {}) or {}).get("qps"),
             "batch_speedup": qps.get("batch_speedup"),
+            "hedged_p99_ms": (tail.get("hedged", {}) or {}).get("p99_ms"),
+            "unhedged_p99_ms": (tail.get("unhedged", {}) or {}).get("p99_ms"),
+            "hedge_rate": tail.get("hedge_rate"),
         },
         "noise": {"run_variance": report.get("run_variance", 0.0)},
     }
@@ -499,11 +509,13 @@ def check_regression(
     lm = latest.get("metrics", {}) or {}
     bm = baseline.get("metrics", {}) or {}
     checks: List[Dict[str, Any]] = []
-    for m in GATE_METRICS:
+    for m in GATE_METRICS + GATE_METRICS_LOWER:
         lv, bv = _finite(lm.get(m)), _finite(bm.get(m))
         if lv is None or bv is None or bv == 0:
             continue
-        drop = (bv - lv) / bv
+        # lower-is-better series invert the sign: a latency RISE is the
+        # regression, so drop = (lv - bv) / bv
+        drop = (lv - bv) / bv if m in GATE_METRICS_LOWER else (bv - lv) / bv
         ok = drop <= allowed
         checks.append(
             {

@@ -103,6 +103,17 @@ class SlowQueryLog:
                 if isinstance(exc, dict) and exc.get("errorCode") == "QUERY_KILLED":
                     entry["kill"] = exc
                     break
+        # tail-tolerance decisions (r15): hedged scatter calls and brownout
+        # transitions surface top-level, so /debug/queries and EXPLAIN
+        # ANALYZE show WHY a tail query came back fast (or didn't)
+        if stats is not None and getattr(stats, "hedged", 0):
+            entry["hedge"] = {
+                "hedged": stats.hedged,
+                "winner": stats.hedge_winner,
+                "cancelledMs": round(stats.hedge_cancelled_ms, 3),
+            }
+        if stats is not None and getattr(stats, "brownout_events", None):
+            entry["brownout"] = list(stats.brownout_events)
         if time_ms >= self.slow_ms or error is not None or "kill" in entry:
             METRICS.counter("broker.slowQueries").inc()
             if stats is not None and stats.trace is not None:

@@ -756,6 +756,79 @@ class TestW018BlockingInDispatch:
         assert _rules(src, threaded=True) == ["W018"]
 
 
+class TestW019RetryLoopDiscipline:
+    def test_flags_retry_loop_without_backoff(self):
+        src = """
+        def scatter(server, ctx, segs, cancel):
+            while segs:
+                res = server.execute(ctx, segs, cancel=cancel)
+                segs = res.failed
+        """
+        assert _rules(src, threaded=True) == ["W019"]
+
+    def test_flags_reissue_without_cancel_probe(self):
+        src = """
+        import time
+
+        def scatter(server, ctx, segs):
+            while segs:
+                res = server.execute(ctx, segs)
+                segs = res.failed
+                time.sleep(0.002)
+        """
+        assert _rules(src, threaded=True) == ["W019"]
+
+    def test_flags_batch_reissue_without_cancels(self):
+        src = """
+        def rebatch(server, ctxs, segs, sleep):
+            while segs:
+                out = server.execute_batch(ctxs, segs)
+                segs = out.failed
+                sleep(0.002)
+        """
+        assert _rules(src, threaded=True) == ["W019"]
+
+    def test_quiet_on_backoff_plus_cancel(self):
+        src = """
+        def scatter(self, server, ctx, segs, cancel):
+            while segs:
+                res = server.execute(ctx, segs, cancel=cancel)
+                segs = res.failed
+                self._sleep(0.002)
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_quiet_on_fan_out_for_loop(self):
+        src = """
+        def fan_out(servers, ctx):
+            out = []
+            for server in servers:
+                out.append(server.execute(ctx, ["seg"]))
+            return out
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_quiet_on_nested_cancel_closure(self):
+        src = """
+        def scatter(self, server, ctx, segs, cancel):
+            while segs:
+                def run_one(name, _segs=segs):
+                    return server.execute(ctx, _segs, cancel=cancel)
+                segs = self._hedged(run_one)
+                self._sleep(0.001)
+        """
+        assert _rules(src, threaded=True) == []
+
+    def test_rule_is_threaded_scope_only(self):
+        src = """
+        def scatter(server, ctx, segs):
+            while segs:
+                segs = server.execute(ctx, segs).failed
+        """
+        assert _rules(src, threaded=False) == []
+        assert sorted(set(_rules(src, threaded=True))) == ["W019"]
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

@@ -122,11 +122,21 @@ W002 additionally covers two Pallas-era shapes (ops/pallas_scan.py):
   * `.block_until_ready()` inside a for/while body — a per-launch fence
     serializes the double-buffered macro-batch pipeline
     (parallel/engine.py drains with one device_get instead).
+
+W020 guards the bit-packed forward-index contract (segment/packing.py):
+inside a Pallas kernel body, an `.astype(...)` whose receiver references a
+packed-word operand (an identifier matching `packed`/`word`) WITHOUT a
+`>>` lane-unpack anywhere in that receiver expression widens the packed
+words to full dtype before the predicate/accumulate — spilling the
+register-resident unpack back into a full-width HBM intermediate, which
+forfeits the bandwidth the packing bought.  Shift first (`_lane_unpack`),
+then cast the unpacked lanes.
 """
 from __future__ import annotations
 
 import ast
 import os
+import re
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Set
 
@@ -144,6 +154,7 @@ RULES: Dict[str, str] = {
     "W017": "wall-clock timing around an async jitted dispatch without a device fence before the stop timestamp",
     "W018": "blocking call (sleep/device fence/socket I/O) inside an async batch-dispatch path",
     "W019": "retry/hedge loop re-issues a server call without bounded backoff or without the cancel-probe path",
+    "W020": "packed words widened via .astype() in a Pallas kernel body before the lane unpack (shift first, then cast)",
     # interprocedural passes (analysis/races.py, analysis/device_sync.py —
     # run via analysis/engine.py over the whole package, not per-file):
     "W010": "lock-guarded attribute read/written without holding its lock",
@@ -241,13 +252,40 @@ def _pallas_kernel_names(tree: ast.AST) -> Set[str]:
     return out
 
 
-class _PallasKernelRules(ast.NodeVisitor):
-    """W002 inside one Pallas kernel body: any host numpy call.
+_PACKED_OPERAND = re.compile(r"packed|word", re.IGNORECASE)
 
-    Stricter than the jit-kernel rule (which allows np scalars like
-    np.int32(0) as weak-type anchors): a Pallas kernel body manipulates
-    Refs, where every np.* call is at best a silent constant fold and at
-    worst a trace error — jnp/lax are the only legal vocabularies."""
+
+def _references_packed_operand(node: ast.AST) -> bool:
+    """Any identifier in the expression smells like a packed-word operand."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and _PACKED_OPERAND.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and _PACKED_OPERAND.search(sub.attr):
+            return True
+    return False
+
+
+def _has_rshift(node: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.RShift)
+        for sub in ast.walk(node)
+    )
+
+
+class _PallasKernelRules(ast.NodeVisitor):
+    """W002 + W020 inside one Pallas kernel body.
+
+    W002: any host numpy call.  Stricter than the jit-kernel rule (which
+    allows np scalars like np.int32(0) as weak-type anchors): a Pallas
+    kernel body manipulates Refs, where every np.* call is at best a
+    silent constant fold and at worst a trace error — jnp/lax are the only
+    legal vocabularies.
+
+    W020: `.astype(...)` on a packed-word operand (identifier matching
+    packed/word) with no `>>` in the receiver — the lane unpack must
+    happen BEFORE any widening cast, or the packed words materialize at
+    full dtype and the bandwidth saving is lost.  A shift in the receiver
+    is the unpack already having happened, so that stays clean."""
 
     def __init__(self, path: str, findings: List[Finding]):
         self.path = path
@@ -264,6 +302,19 @@ class _PallasKernelRules(ast.NodeVisitor):
                 Finding(
                     self.path, node.lineno, "W002",
                     f"{f.value.id}.{f.attr}() is a host numpy call inside a Pallas kernel body",
+                )
+            )
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "astype"
+            and _references_packed_operand(f.value)
+            and not _has_rshift(f.value)
+        ):
+            self.findings.append(
+                Finding(
+                    self.path, node.lineno, "W020",
+                    "packed words widened via .astype() before the lane "
+                    "unpack — shift (>>) the lanes out first, then cast",
                 )
             )
         self.generic_visit(node)

@@ -129,6 +129,20 @@ def _row_iota(shape_len: int):
     return lax.broadcasted_iota(jnp.int32, (shape_len, 1), 0).reshape(shape_len)
 
 
+def _lane_unpack(w, bits: int, rows: int):
+    """In-register lane unpack: [rows * bits // 32] uint32 words -> [rows]
+    uint32 lanes, lane l of word i covering row i * (32 // bits) + l.
+
+    The shared primitive behind BOTH packed operand kinds: range-index
+    bitmap words are the bits=1 case (one bool per lane), bit-packed
+    forward indexes (segment/packing.py) the bits=4/8/16 case.  Pure
+    shift/mask on the VPU — the packed word tile is the only HBM read and
+    the widened lanes never leave registers/VMEM."""
+    f = 32 // bits
+    shifts = lax.broadcasted_iota(jnp.uint32, (rows // f, f), 1) * jnp.uint32(bits)
+    return ((w[:, None] >> shifts) & jnp.uint32((1 << bits) - 1)).reshape(rows)
+
+
 def fused_group_tables_pallas(
     entries,
     codes,
@@ -136,6 +150,7 @@ def fused_group_tables_pallas(
     *,
     mask_words=None,
     code_pred: Optional[Tuple[Any, int, int]] = None,
+    codes_packed: Optional[Tuple[Any, int]] = None,
     interpret: bool = False,
 ):
     """Pallas twin of segmented.fused_group_tables for integer kinds.
@@ -146,8 +161,13 @@ def fused_group_tables_pallas(
     word-slice layout of query/filter.eval_bitmap) ANDed into every entry
     mask IN-REGISTER, so the row-length bool mask never exists in HBM.
     code_pred: optional (codes_array, lo, hi) dictionary-code range
-    predicate, likewise fused.  Returns f64[num_groups] tables in entry
-    order, bit-identical to the XLA path (both are exact integer sums).
+    predicate, likewise fused.  codes_packed: optional (words, code_bits)
+    bit-packed forward index of the key column (segment/packing.py lanes);
+    the kernel streams the uint32 word tiles — a 32/code_bits-factor
+    super-tile of rows per word tile — and lane-unpacks in-register, so
+    the key's HBM traffic is its PACKED byte count.  Returns
+    f64[num_groups] tables in entry order, bit-identical to the XLA path
+    (both are exact integer sums).
 
     Rows are padded to a _TILE multiple when needed (padding carries
     mask=False, so padded rows contribute exactly nothing); 32-aligned
@@ -164,8 +184,18 @@ def fused_group_tables_pallas(
     H = -(-num_groups // _W)
     Hp = -(-H // 8) * 8  # pad the sublane dim for TPU tiling
 
-    inputs: List[Any] = [codes]
-    in_specs: List[Any] = [pl.BlockSpec((T,), lambda i: (i,))]
+    key_bits = None
+    if codes_packed is not None:
+        kw, key_bits = codes_packed
+        key_bits = int(key_bits)
+        key_factor = 32 // key_bits
+        if n % key_factor or int(kw.shape[0]) != n // key_factor:
+            raise ValueError("codes_packed rows must be lane-aligned with codes")
+        inputs: List[Any] = [kw]
+        in_specs: List[Any] = [pl.BlockSpec((T // key_factor,), lambda i: (i,))]
+    else:
+        inputs = [codes]
+        in_specs = [pl.BlockSpec((T,), lambda i: (i,))]
     ix_of: Dict[int, int] = {}
 
     def _operand(arr) -> int:
@@ -228,7 +258,14 @@ def fused_group_tables_pallas(
         pad = n_tiles * T - n
         padded = []
         for ix, a in enumerate(inputs):
-            w = pad // 32 if ix == words_ix else pad
+            # packed operands pad by lanes-per-word: bitmap words carry 32
+            # rows each, key words 32 // key_bits
+            if ix == words_ix:
+                w = pad // 32
+            elif ix == 0 and key_bits is not None:
+                w = pad * key_bits // 32
+            else:
+                w = pad
             padded.append(jnp.pad(a, (0, w)))
         inputs = padded
 
@@ -240,12 +277,14 @@ def fused_group_tables_pallas(
         def _init():
             out_ref[...] = jnp.zeros_like(out_ref)
 
-        ki = refs[0][...].astype(jnp.int32)
+        if key_bits is not None:
+            # super-tile key read: T rows arrive as T * key_bits / 32 words
+            ki = _lane_unpack(refs[0][...], key_bits, T).astype(jnp.int32)
+        else:
+            ki = refs[0][...].astype(jnp.int32)
         base = None
         if words_ix is not None:
-            w = refs[words_ix][...]
-            shifts = lax.broadcasted_iota(jnp.uint32, (T // 32, 32), 1)
-            base = (((w[:, None] >> shifts) & jnp.uint32(1)) != jnp.uint32(0)).reshape(T)
+            base = _lane_unpack(refs[words_ix][...], 1, T) != jnp.uint32(0)
         if pred_plan is not None:
             p_ix, plo, phi = pred_plan
             pc = refs[p_ix][...].astype(jnp.int32)

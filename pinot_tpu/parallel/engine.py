@@ -78,7 +78,7 @@ def flatten_cols(cols):
     for name, entry in cols.items():
         e = {}
         for k, v in entry.items():
-            if k in ("codes", "values", "nulls", "lengths"):
+            if k in ("codes", "codes_packed", "values", "nulls", "lengths"):
                 e[k] = v.reshape((-1,) + v.shape[2:])
             else:
                 e[k] = v
@@ -586,18 +586,22 @@ class DistributedEngine:
         # on-device slices of the same column (review-caught: at 1B rows the
         # second slicing is the OOM the batching exists to prevent).  Narrow
         # queries over-batch slightly; launch overhead is microseconds.
-        bytes_per_doc = 0
+        bytes_per_doc = 0.0
         for c in stacked.columns.values():
             if c.codes is not None:
                 width = c.codes.shape[2] if c.codes.ndim == 3 else 1
-                bytes_per_doc += c.codes.dtype.itemsize * width
+                if getattr(c, "code_bits", None) and c.packed is not None:
+                    # packed forward index ships the uint32 lane words
+                    bytes_per_doc += c.code_bits / 8.0 * width
+                else:
+                    bytes_per_doc += c.codes.dtype.itemsize * width
             if c.values is not None:
                 bytes_per_doc += c.values.dtype.itemsize
             if c.nulls is not None:
                 bytes_per_doc += 1
             if c.mv_lengths is not None:
                 bytes_per_doc += c.mv_lengths.dtype.itemsize
-        per_dev = max(1, bytes_per_doc) * L * D
+        per_dev = int(max(1.0, bytes_per_doc) * L * D)
         n_batches = max(1, -(-per_dev // self.launch_bytes))
         if n_batches == 1 or D < 64:
             return D, ((0, 0),)
@@ -697,7 +701,31 @@ class DistributedEngine:
             )
 
         null_handling = ctx.null_handling
-        _flat = flatten_cols
+        # Bit-packed forward indexes: to_device(packed_codes=True) ships
+        # uint32 lane words under "codes_packed" instead of the unpacked
+        # codes; every kernel sees an overlay that adds trace-level unpacked
+        # "codes" (XLA dedups/DCEs; the Pallas fused path additionally gets
+        # the raw words via key_packed and unpacks in-register).
+        packed_meta: Dict[str, int] = {
+            name: int(c.code_bits)
+            for name, c in stacked.columns.items()
+            if getattr(c, "code_bits", None)
+            and getattr(c, "packed", None) is not None
+        }
+
+        def _flat(cols, _rows=local_rows):
+            from pinot_tpu.segment import packing
+
+            out = flatten_cols(cols)
+            for name, bits in packed_meta.items():
+                e = out.get(name)
+                if e is not None and "codes_packed" in e and "codes" not in e:
+                    e = dict(e)
+                    e["codes"] = packing.unpack_codes_jnp(
+                        e["codes_packed"], bits, _rows
+                    )
+                    out[name] = e
+            return out
         _agg_inputs = make_agg_inputs(agg_specs, aggs, agg_filter_fns, view, stacked, null_handling)
 
         def _group_key(cols):
@@ -708,6 +736,17 @@ class DistributedEngine:
                 code = gd.device_code(cols, view, jnp.int32)
                 key = code if key is None else key * np.int32(gd.cardinality) + code
             return key
+
+        def _key_packed(cols):
+            """(words, bits) for the group key when its bit-packed forward
+            index shipped — lets the Pallas scan skip the unpacked codes."""
+            if len(group_dims) != 1 or group_dims[0].kind != "dict":
+                return None
+            bits = packed_meta.get(group_dims[0].name)
+            e = cols.get(group_dims[0].name)
+            if not bits or e is None or "codes_packed" not in e:
+                return None
+            return (e["codes_packed"], bits)
 
         sparse_merge_fn = None  # set by the groupby_sparse branch when eligible
 
@@ -757,6 +796,7 @@ class DistributedEngine:
                         aggs, inputs, tmask, key, num_groups, vranges,
                         backend=scan_be,
                         mask_words=params[word_key].reshape(-1),
+                        key_packed=_key_packed(cols),
                     )
                     presence = lax.psum(presence, axis)
                     partials = [
@@ -775,7 +815,8 @@ class DistributedEngine:
                     key = _group_key(cols)
                     inputs = _agg_inputs(cols, params, tmask)
                     presence, partials = planner_mod.grouped_partials(
-                        aggs, inputs, tmask, key, num_groups, vranges, backend=scan_be
+                        aggs, inputs, tmask, key, num_groups, vranges,
+                        backend=scan_be, key_packed=_key_packed(cols),
                     )
                     presence = lax.psum(presence, axis)
                     partials = [
@@ -872,7 +913,7 @@ class DistributedEngine:
                 out[name] = {
                     k: (
                         P(axis, *([None] * (v.ndim - 1)))
-                        if k in ("codes", "values", "nulls", "lengths")
+                        if k in ("codes", "codes_packed", "values", "nulls", "lengths")
                         else P()
                     )
                     for k, v in entry.items()
@@ -969,6 +1010,7 @@ class DistributedEngine:
             cols, _ = stacked.to_device(
                 self.mesh, self.axis, plan.needed_columns,
                 doc_slice=(off, off + plan.batch_docs), with_valid=False,
+                packed_codes=True,
             )
             params = dict(shared)
             for k, v in self.batch_params(plan, off, fresh).items():

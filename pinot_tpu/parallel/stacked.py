@@ -39,6 +39,12 @@ class StackedColumn:
     # multi-value: [S, D] per-row element counts; padded cells hold the
     # padding code (== cardinality), mirroring segment/builder MV layout
     mv_lengths: Optional[np.ndarray] = None
+    # bit-packed forward index (segment/packing.py layout): codes in
+    # `code_bits`-wide lanes inside uint32 words, [S, D * code_bits / 32].
+    # D is 32-aligned so no word straddles a shard boundary.  None when the
+    # cardinality needs >16 bits (stored unpacked) or the column is MV.
+    code_bits: Optional[int] = None
+    packed: Optional[np.ndarray] = None
 
     @property
     def is_multi_value(self) -> bool:
@@ -139,6 +145,7 @@ class StackedTable:
                     name,
                     c.dictionary.fingerprint() if c.dictionary else None,
                     str((c.codes if c.codes is not None else c.values).dtype),
+                    c.code_bits,  # packed vs unpacked trace different kernels
                     c.nulls is not None,
                     column_limb_sig(c),
                     c.stats.is_sorted,
@@ -207,12 +214,30 @@ class StackedTable:
                 padded_nulls[:n] = nmask
                 padded_nulls = padded_nulls.reshape(num_shards, D)
             if use_dict:
+                from pinot_tpu.segment import packing
+
                 dictionary, codes32 = Dictionary.build(f.data_type, arr)
                 codes = np.zeros(total, dtype=min_code_dtype(dictionary.cardinality))
                 codes[:n] = codes32.astype(codes.dtype)
                 stats = collect_stats(f.name, f.data_type, arr, nmask, dictionary.cardinality, True)
+                bits = packing.lane_bits(dictionary.cardinality)
+                # D is 32-aligned, so packing the flat codes and reshaping
+                # never straddles a shard boundary with one word
+                packed = (
+                    packing.pack_codes(codes, bits).reshape(num_shards, -1)
+                    if bits < 32
+                    else None
+                )
                 columns[f.name] = StackedColumn(
-                    f.name, f.data_type, dictionary, codes.reshape(num_shards, D), None, padded_nulls, stats
+                    f.name,
+                    f.data_type,
+                    dictionary,
+                    codes.reshape(num_shards, D),
+                    None,
+                    padded_nulls,
+                    stats,
+                    code_bits=bits if bits < 32 else None,
+                    packed=packed,
                 )
                 card = dictionary.cardinality
                 if idx_cfg is not None and card <= MAX_BITMAP_INDEX_CARDINALITY:
@@ -322,6 +347,7 @@ class StackedTable:
         columns: Optional[List[str]] = None,
         doc_slice: Optional[Tuple[int, int]] = None,
         with_valid: bool = True,
+        packed_codes: bool = False,
     ):
         """Shard row arrays over the mesh axis; dictionaries replicate.
 
@@ -358,13 +384,28 @@ class StackedTable:
             # (aliased_view) rename columns but share the numpy storage —
             # identity keys mean one HBM copy serves every alias
             arr_id = id(c.codes if c.codes is not None else c.values)
-            ck = (arr_id, sl)
+            # packed shipping needs lane-aligned doc offsets (macro-batch
+            # offsets are 32-aligned by _batching, so this always holds there)
+            use_packed = bool(
+                packed_codes
+                and c.packed is not None
+                and sl[0] % (32 // c.code_bits) == 0
+                and sl[1] % (32 // c.code_bits) == 0
+            )
+            ck = (arr_id, sl, "#packed") if use_packed else (arr_id, sl)
             if ck in cache:
                 out[cname] = cache[ck]
                 continue
             entry: Dict[str, Any] = {}
+            if use_packed:
+                f = 32 // c.code_bits
+                w = c.packed[:, sl[0] // f : sl[1] // f]
+                entry["codes_packed"] = jax.device_put(
+                    np.ascontiguousarray(w), row_sharding
+                )
             if c.codes is not None:
-                entry["codes"] = jax.device_put(_rows(c.codes), row_sharding)
+                if not use_packed:
+                    entry["codes"] = jax.device_put(_rows(c.codes), row_sharding)
                 dkey = (id(c.dictionary), "dict")
                 dvals = c.dictionary.device_values()
                 if dvals is not None:

@@ -120,7 +120,9 @@ def launch_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
     )
     plan = planner.plan_segment(ctx, segment)
     stats.filter_index_uses = tuple(plan.index_uses)
-    cols = segment.to_device(device=device, columns=plan.needed_columns)
+    cols = segment.to_device(
+        device=device, columns=plan.needed_columns, packed_codes=True
+    )
     params = {k: jax.device_put(v, device) for k, v in plan.params.items()}
     first_launch = plan.cost is None
     if first_launch:
@@ -308,7 +310,9 @@ def launch_segment_batch(ctxs: List[QueryContext], segment: ImmutableSegment, de
     params_list = [p.params for p in plans]
     if n < width:
         params_list = params_list + [plans[-1].params] * (width - n)
-    cols = segment.to_device(device=device, columns=base.needed_columns)
+    cols = segment.to_device(
+        device=device, columns=base.needed_columns, packed_codes=True
+    )
     stacked = {}
     for k, v0 in base.params.items():
         if k in shared_keys:

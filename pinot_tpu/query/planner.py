@@ -231,6 +231,9 @@ def _segment_signature(
                 name,
                 c.cardinality if c.has_dictionary else -1,
                 str(c.codes.dtype if c.codes is not None else c.values.dtype),
+                # packed lane width: packed and unpacked segments trace
+                # different kernels (word inputs vs code inputs)
+                getattr(c, "code_bits", None),
                 c.nulls is not None,
                 raw_range,
                 sketch_extra,
@@ -592,7 +595,7 @@ def agg_vranges(agg_specs, table_like) -> List[Optional[Tuple[int, int]]]:
 
 
 def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges,
-                     backend=None, mask_words=None):
+                     backend=None, mask_words=None, key_packed=None):
     """Presence table + per-agg grouped partial dicts for the dense path.
 
     All additive fields (presence, counts, sums, sums of squares) across ALL
@@ -605,9 +608,13 @@ def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges,
     backend tags the plan-time scan backend (ops.scan_backend()) so eligible
     entry sets route to the Pallas fused kernel.  mask_words optionally
     carries the filter as PACKED uint32 bitmap words instead of folded into
-    tmask/input masks — the Pallas scan unpacks them in-register.  Scatter
-    and sketch paths never see packed words, so they are defensively
-    unpacked here whenever any aggregation needs a non-fusable field."""
+    tmask/input masks — the Pallas scan unpacks them in-register.
+    key_packed optionally carries the group-key column's bit-packed forward
+    index as (words, code_bits) so the Pallas scan streams packed key bytes
+    and lane-unpacks in-register; `key` must still be the (trace-level
+    unpacked) codes for every non-Pallas consumer.  Scatter and sketch
+    paths never see packed words, so they are defensively unpacked here
+    whenever any aggregation needs a non-fusable field."""
     if mask_words is not None:
         fuse_ok = all(fn.field_kinds is not None for fn in aggs) and all(
             k in ("count", "sum", "sumsq")
@@ -668,7 +675,8 @@ def grouped_partials(aggs, inputs, tmask, key, num_groups: int, vranges,
         requests.append(("fields", fmap))
 
     tables = ops.fused_group_tables(
-        entries, key, num_groups, backend=backend, mask_words=mask_words
+        entries, key, num_groups, backend=backend, mask_words=mask_words,
+        codes_packed=key_packed,
     )
 
     def _as_table(idx):
@@ -1004,6 +1012,31 @@ def _build_plan(
     keep = _non_filter_columns(ctx, segment) | fc.used_columns
     needed = [c for c in needed if c in keep]
 
+    # Bit-packed forward indexes (segment/packing.py): columns the executor
+    # may ship as uint32 lane words ("codes_packed" entries).  The kernel
+    # overlays a trace-time vectorized-shift unpack so every existing
+    # reader sees "codes" unchanged; XLA dedups the single unpack across
+    # readers and DCEs it when the Pallas path consumes the words directly.
+    packed_meta: Dict[str, int] = {}
+    for name in needed:
+        c = segment.column(name)
+        bits = getattr(c, "code_bits", None)
+        if bits and getattr(c, "packed", None) is not None:
+            packed_meta[name] = int(bits)
+    num_docs = segment.num_docs
+
+    def _overlay_unpacked(cols):
+        from pinot_tpu.segment import packing
+
+        out = dict(cols)
+        for name, bits in packed_meta.items():
+            e = out.get(name)
+            if e is not None and "codes_packed" in e and "codes" not in e:
+                e = dict(e)
+                e["codes"] = packing.unpack_codes_jnp(e["codes_packed"], bits, num_docs)
+                out[name] = e
+        return out
+
     if ctx.is_aggregate and not ctx.group_by:
         kind = "aggregation"
         group_dims: List[GroupDim] = []
@@ -1069,6 +1102,22 @@ def _build_plan(
             code = gd.device_code(cols, segment, jnp.int32)
             key = code if key is None else key * np.int32(gd.cardinality) + code
         return key
+
+    def _key_packed(cols):
+        """(words, code_bits) when the single dict group key shipped packed
+        AND the Pallas backend can lane-unpack it in-register; else None."""
+        if scan_be not in ("pallas", "interpret") or len(group_dims) != 1:
+            return None
+        gd = group_dims[0]
+        if gd.kind != "dict" or gd.mv:
+            return None
+        bits = packed_meta.get(gd.name)
+        if not bits or num_docs % (32 // bits):
+            return None
+        e = cols.get(gd.name)
+        if e is None or "codes_packed" not in e:
+            return None
+        return (e["codes_packed"], bits)
 
     if kind == "aggregation":
 
@@ -1136,7 +1185,7 @@ def _build_plan(
             key = _group_key(cols, params)
             inputs = _agg_inputs(cols, params, tmask)
             return grouped_partials(aggs, inputs, tmask, key, num_groups, vranges,
-                                    backend=scan_be)
+                                    backend=scan_be, key_packed=_key_packed(cols))
 
     elif kind == "groupby_sparse":
         # Device-side sort+scatter into fixed [numGroupsLimit] tables — no
@@ -1166,6 +1215,12 @@ def _build_plan(
         def kernel(cols, params):
             tmask, _ = filter_fn(cols, params)
             return tmask
+
+    if packed_meta:
+        base_kernel = kernel
+
+        def kernel(cols, params):
+            return base_kernel(_overlay_unpacked(cols), params)
 
     fn = compiled_fn if compiled_fn is not None else jax.jit(kernel)
 

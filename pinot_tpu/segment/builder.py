@@ -27,6 +27,7 @@ import numpy as np
 
 from pinot_tpu.indexes.bloom import BloomFilter
 from pinot_tpu.indexes.inverted import InvertedIndex, RangeEncodedIndex
+from pinot_tpu.segment import packing
 from pinot_tpu.segment.dictionary import Dictionary, min_code_dtype
 from pinot_tpu.segment.segment import ColumnData, ImmutableSegment
 from pinot_tpu.segment.stats import ColumnStats, collect_stats
@@ -154,7 +155,15 @@ def build_segment(
             dictionary, codes32 = Dictionary.build(f.data_type, arr)
             codes = codes32.astype(min_code_dtype(dictionary.cardinality))
             stats = collect_stats(f.name, f.data_type, arr, nmask, dictionary.cardinality, True)
-            columns[f.name] = ColumnData(f.name, f.data_type, dictionary, codes, None, nmask, stats)
+            # bit-pack the forward index when the cardinality fits a 4/8/16
+            # bit lane (segment/packing.py); codes stay materialized for
+            # host-side consumers (index builds, sorted searchsorted, decode)
+            bits = packing.lane_bits(dictionary.cardinality)
+            columns[f.name] = ColumnData(
+                f.name, f.data_type, dictionary, codes, None, nmask, stats,
+                code_bits=bits if bits < 32 else None,
+                packed=packing.pack_codes(codes, bits) if bits < 32 else None,
+            )
             card = dictionary.cardinality
             if f.name in idx_cfg.inverted_index_columns:
                 if card <= MAX_BITMAP_INDEX_CARDINALITY:

@@ -1,0 +1,88 @@
+"""Bit-packed forward indexes: dictionary codes in 4/8/16-bit lanes.
+
+Codes for a dictionary column with cardinality C need only
+ceil(log2(C)) bits each; storing them at int32 (or even uint8/16)
+width wastes HBM bandwidth on the scan hot path.  This module packs
+codes into little-endian lanes inside uint32 words:
+
+    factor f = 32 // bits          lanes per word
+    word w, lane l                 covers row w * f + l
+    code  = (word >> (bits * l)) & ((1 << bits) - 1)
+
+The layout deliberately generalizes the range-index bitmap layout
+(bits=1: bit r of word w covers row 32*w + r), so the Pallas kernel's
+word-unpack machinery serves both.
+
+Only power-of-two lane widths that divide 32 are used (4/8/16); a
+column whose cardinality needs >16 bits stays unpacked (32 means "no
+packing").  Multi-value columns stay unpacked too: their padding code
+equals the cardinality, which may not fit the lane width chosen from
+cardinality alone.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+LANE_WIDTHS = (4, 8, 16)
+
+
+def lane_bits(cardinality: int) -> int:
+    """Narrowest supported lane width for a dictionary of this size.
+
+    Returns 32 when the column does not benefit (codes would need more
+    than 16 bits), meaning "store unpacked".
+    """
+    for bits in LANE_WIDTHS:
+        if cardinality <= (1 << bits):
+            return bits
+    return 32
+
+
+def pack_codes(codes: np.ndarray, bits: int) -> np.ndarray:
+    """Pack int codes into uint32 words, `32 // bits` lanes per word.
+
+    The tail word is zero-padded (zero is always a valid in-range lane,
+    and consumers mask rows >= n).
+    """
+    if bits not in LANE_WIDTHS:
+        raise ValueError(f"unsupported lane width: {bits}")
+    factor = 32 // bits
+    n = int(codes.shape[0])
+    words = -(-n // factor)
+    lanes = np.zeros(words * factor, dtype=np.uint32)
+    lanes[:n] = codes.astype(np.uint32, copy=False)
+    lanes = lanes.reshape(words, factor)
+    shifts = (np.arange(factor, dtype=np.uint32) * np.uint32(bits))[None, :]
+    return np.bitwise_or.reduce(lanes << shifts, axis=1).astype(np.uint32)
+
+
+def unpack_codes(words: np.ndarray, bits: int, n: int, dtype=np.uint32) -> np.ndarray:
+    """Numpy inverse of pack_codes: first n lanes as an unpacked array."""
+    if bits not in LANE_WIDTHS:
+        raise ValueError(f"unsupported lane width: {bits}")
+    factor = 32 // bits
+    shifts = (np.arange(factor, dtype=np.uint32) * np.uint32(bits))[None, :]
+    mask = np.uint32((1 << bits) - 1)
+    lanes = (words.astype(np.uint32, copy=False)[:, None] >> shifts) & mask
+    return lanes.reshape(-1)[:n].astype(dtype, copy=False)
+
+
+def unpack_codes_jnp(words, bits: int, n: int, dtype=None):
+    """Trace-time unpack with vectorized shifts (CPU/XLA fallback path).
+
+    Unpacks along the LAST axis (1-D segment codes or [shards, words]
+    stacked layouts alike).  `bits` and `n` (lanes kept per row of the
+    last axis) must be static; `words` may be a traced uint32 array.
+    Returns int32 by default — the width device readers expect from
+    `.astype(jnp.int32)` anyway.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if dtype is None:
+        dtype = jnp.int32
+    factor = 32 // bits
+    w = words.astype(jnp.uint32)
+    shifts = lax.broadcasted_iota(jnp.uint32, w.shape + (factor,), w.ndim) * jnp.uint32(bits)
+    lanes = (w[..., None] >> shifts) & jnp.uint32((1 << bits) - 1)
+    return lanes.reshape(w.shape[:-1] + (w.shape[-1] * factor,))[..., :n].astype(dtype)

@@ -22,10 +22,15 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from pinot_tpu.segment import store
+from pinot_tpu.segment import packing, store
 from pinot_tpu.segment.dictionary import Dictionary, min_code_dtype
 from pinot_tpu.segment.stats import ColumnStats
 from pinot_tpu.spi.schema import DataType, Schema
+
+# Bumped when build-time encoding changes shape (v2: bit-packed forward
+# indexes).  Segments carry it in meta; absent/v1 segments have no
+# `codeBits` column attribute and load through the raw path unchanged.
+BUILDER_VERSION = 2
 
 
 @dataclass
@@ -43,6 +48,13 @@ class ColumnData:
     # multi-value columns: per-row element counts; codes beyond a row's
     # length hold the padding code (== cardinality)
     mv_lengths: Optional[np.ndarray] = None
+    # bit-packed forward index (segment/packing.py): `packed` holds codes in
+    # `code_bits`-wide lanes inside uint32 words.  `codes` stays materialized
+    # host-side (index builds, sorted searchsorted, decode); `packed` is what
+    # save() persists and to_device(packed_codes=True) ships.  None on raw,
+    # MV, and wide (>16-bit) columns.
+    code_bits: Optional[int] = None
+    packed: Optional[np.ndarray] = None
 
     @property
     def has_dictionary(self) -> bool:
@@ -166,34 +178,52 @@ class ImmutableSegment:
         return list(self.columns)
 
     # -- device residency ----------------------------------------------
-    def to_device(self, device=None, columns: Optional[List[str]] = None) -> Dict[str, Any]:
+    def to_device(
+        self,
+        device=None,
+        columns: Optional[List[str]] = None,
+        packed_codes: bool = False,
+    ) -> Dict[str, Any]:
         """Pin column arrays into device memory; returns the segment pytree.
 
         The pytree is cached — segments are immutable so repeated queries hit
         HBM-resident arrays (the AcquireReleaseColumnsSegment analog is the
-        residency manager in query/executor.py)."""
+        residency manager in query/executor.py).
+
+        packed_codes=True ships bit-packed columns as uint32 lane words under
+        entry key "codes_packed" instead of widened "codes" — opt-in because
+        only plan kernels that unpack at trace time (or route the words to
+        the Pallas lane-unpack) can consume it; direct `cols[n]["codes"]`
+        readers keep the default.  Packed entries cache under a distinct
+        key so the two shapes never alias."""
         import jax
 
         cache = self._device_cache.setdefault(device, {})
         cols = columns or list(self.columns)
+        out: Dict[str, Any] = {}
         for cname in cols:
-            if cname in cache:
-                continue
             c = self.columns[cname]
-            entry: Dict[str, Any] = {}
-            if c.codes is not None:
-                entry["codes"] = jax.device_put(np.asarray(c.codes), device)
-                dvals = c.dictionary.device_values() if c.dictionary else None
-                if dvals is not None:
-                    entry["dict"] = jax.device_put(dvals, device)
-            if c.values is not None:
-                entry["values"] = jax.device_put(np.asarray(c.values), device)
-            if c.nulls is not None:
-                entry["nulls"] = jax.device_put(np.asarray(c.nulls), device)
-            if c.mv_lengths is not None:
-                entry["lengths"] = jax.device_put(np.asarray(c.mv_lengths), device)
-            cache[cname] = entry
-        return {cname: cache[cname] for cname in cols}
+            use_packed = bool(packed_codes and c.packed is not None)
+            key = f"{cname}#packed" if use_packed else cname
+            if key not in cache:
+                entry: Dict[str, Any] = {}
+                if use_packed:
+                    entry["codes_packed"] = jax.device_put(np.asarray(c.packed), device)
+                elif c.codes is not None:
+                    entry["codes"] = jax.device_put(np.asarray(c.codes), device)
+                if c.codes is not None:
+                    dvals = c.dictionary.device_values() if c.dictionary else None
+                    if dvals is not None:
+                        entry["dict"] = jax.device_put(dvals, device)
+                if c.values is not None:
+                    entry["values"] = jax.device_put(np.asarray(c.values), device)
+                if c.nulls is not None:
+                    entry["nulls"] = jax.device_put(np.asarray(c.nulls), device)
+                if c.mv_lengths is not None:
+                    entry["lengths"] = jax.device_put(np.asarray(c.mv_lengths), device)
+                cache[key] = entry
+            out[cname] = cache[key]
+        return out
 
     def release_device(self) -> None:
         self._device_cache = {}
@@ -205,20 +235,23 @@ class ImmutableSegment:
         for c in self.columns.values():
             if c.dictionary is not None:
                 regions.extend(c.dictionary.to_regions(c.name))
-                regions.append((f"{c.name}.fwd", c.codes))
+                # packed columns persist the lane words; codes are
+                # rematerialized at load via packing.unpack_codes
+                regions.append((f"{c.name}.fwd", c.packed if c.packed is not None else c.codes))
             else:
                 regions.append((f"{c.name}.fwd", c.values))
             if c.nulls is not None:
                 regions.append((f"{c.name}.nulls", np.packbits(c.nulls)))
             if c.mv_lengths is not None:
                 regions.append((f"{c.name}.mvlen", c.mv_lengths))
-            col_meta.append(
-                {
-                    "stats": c.stats.to_dict(),
-                    "hasNulls": c.nulls is not None,
-                    "isMV": c.mv_lengths is not None,
-                }
-            )
+            cm = {
+                "stats": c.stats.to_dict(),
+                "hasNulls": c.nulls is not None,
+                "isMV": c.mv_lengths is not None,
+            }
+            if c.packed is not None:
+                cm["codeBits"] = int(c.code_bits)
+            col_meta.append(cm)
         for kind, by_col in self.indexes.items():
             for cname, idx in by_col.items():
                 regions.extend(idx.to_regions(f"{cname}.{kind}"))
@@ -226,6 +259,7 @@ class ImmutableSegment:
             "segmentName": self.name,
             "tableName": self.table_name,
             "numDocs": self.num_docs,
+            "builderVersion": BUILDER_VERSION,
             "schema": self.schema.to_dict(),
             "columns": col_meta,
             "indexes": {kind: {c: idx.meta() for c, idx in by_col.items()} for kind, by_col in self.indexes.items()},
@@ -259,9 +293,20 @@ class ImmutableSegment:
                 nulls = np.unpackbits(np.asarray(regions[f"{name}.nulls"]), count=num_docs).astype(bool)
             if stats.has_dictionary:
                 dictionary = Dictionary.from_regions(dt, regions, name)
-                codes = regions[f"{name}.fwd"]
+                fwd = regions[f"{name}.fwd"]
                 mv_lengths = regions[f"{name}.mvlen"] if cm.get("isMV") else None
-                columns[name] = ColumnData(name, dt, dictionary, codes, None, nulls, stats, mv_lengths=mv_lengths)
+                bits = cm.get("codeBits")  # absent on pre-v2 segments: raw path
+                packed = None
+                codes = fwd
+                if bits and bits < 32:
+                    packed = np.asarray(fwd)
+                    codes = packing.unpack_codes(
+                        packed, bits, num_docs, dtype=min_code_dtype(dictionary.cardinality)
+                    )
+                columns[name] = ColumnData(
+                    name, dt, dictionary, codes, None, nulls, stats,
+                    mv_lengths=mv_lengths, code_bits=bits, packed=packed,
+                )
             else:
                 mv_lengths = regions[f"{name}.mvlen"] if cm.get("isMV") else None
                 columns[name] = ColumnData(

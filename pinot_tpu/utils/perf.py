@@ -137,14 +137,21 @@ def capture_cost(fn, args: tuple, analytic: KernelCost, force: Optional[str] = N
 
 def analytic_bytes_per_row(columns, bitmap_params: int = 0) -> float:
     """Bytes the scan streams per row under the packed-storage model: each
-    needed column at its stored width (dict codes at code width, raw columns
-    at value width), null bitmaps at 1 byte/row, plus one uint32 per 32 rows
-    per row-sharded index-bitmap parameter — the same model bench.py uses."""
+    needed column at its stored width — bit-packed dict columns at
+    `code_bits / 8` (the uint32 lane words are what actually stream; see
+    segment/packing.py), unpacked dict codes at code dtype width, raw
+    columns at value width — null bitmaps at 1 byte/row, plus one uint32
+    per 32 rows per row-sharded index-bitmap parameter — the same model
+    bench.py uses."""
     bpr = 0.0
     for c in columns:
         arr = c.codes if getattr(c, "codes", None) is not None else c.values
         if arr is not None:
-            bpr += arr.dtype.itemsize
+            bits = getattr(c, "code_bits", None)
+            if bits and getattr(c, "packed", None) is not None:
+                bpr += bits / 8.0  # MV columns never pack, so no width factor
+            else:
+                bpr += arr.dtype.itemsize
         if getattr(c, "nulls", None) is not None:
             bpr += 1
     return bpr + bitmap_params * 4.0 / 32.0
@@ -400,6 +407,11 @@ GATE_METRICS: Tuple[str, ...] = (
     "warm_p50_rows_per_sec",
     "effective_bytes_per_sec",
     "batched_qps",
+    # packed-forward-index sections (bench.py scan_bound / agg_bound): a
+    # low-selectivity filter scan and a group-by-heavy aggregation, both
+    # streaming bit-packed columns
+    "scan_bound_rows_per_sec",
+    "agg_bound_rows_per_sec",
 )
 
 # Lower-is-better latency series: the gate fails when these RISE past the
@@ -423,6 +435,8 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
     roofline = report.get("roofline", {}) or {}
     qps = report.get("concurrent_qps", {}) or {}
     tail = report.get("tail_latency", {}) or {}
+    scan_b = report.get("scan_bound", {}) or {}
+    agg_b = report.get("agg_bound", {}) or {}
     return {
         "schema": 1,
         "bench": bench,
@@ -443,6 +457,10 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
             "hedged_p99_ms": (tail.get("hedged", {}) or {}).get("p99_ms"),
             "unhedged_p99_ms": (tail.get("unhedged", {}) or {}).get("p99_ms"),
             "hedge_rate": tail.get("hedge_rate"),
+            "scan_bound_rows_per_sec": scan_b.get("rows_per_sec"),
+            "scan_bound_roofline_pct": scan_b.get("roofline_pct"),
+            "agg_bound_rows_per_sec": agg_b.get("rows_per_sec"),
+            "agg_bound_roofline_pct": agg_b.get("roofline_pct"),
         },
         "noise": {"run_variance": report.get("run_variance", 0.0)},
     }

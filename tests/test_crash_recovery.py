@@ -292,6 +292,40 @@ class TestServerCrashRestart:
         assert os.path.isdir(local + ".corrupt")  # evidence quarantined
         assert srv.get_segment("t", seg_name) is not None
 
+    def test_packed_fwd_region_crc_round_trip(self, tmp_path):
+        """Bit-packed forward-index words sit inside the CRC envelope: the
+        deep-store copy round-trips them bit-exactly, and a flipped byte in
+        the packed `.fwd` region alone fails verify_segment."""
+        from pinot_tpu.segment.segment import ImmutableSegment
+        from pinot_tpu.segment.store import read_segment
+
+        out = str(tmp_path / "seg")
+        seg = build_segment(_schema(), _data(400, seed=11), "seg", output_dir=out)
+        c = seg.column("city")
+        assert c.code_bits == 4 and c.packed is not None  # card 3 -> 4-bit lanes
+        verify_segment(out)
+        loaded = ImmutableSegment.load(out, verify=True)
+        np.testing.assert_array_equal(loaded.column("city").packed, c.packed)
+        np.testing.assert_array_equal(loaded.column("city").codes, c.codes)
+
+        meta, _ = read_segment(out)
+        (reg,) = [r for r in meta["regions"] if r["name"] == "city.fwd"]
+        bin_path = os.path.join(out, "columns.bin")
+        with open(bin_path, "r+b") as f:
+            f.seek(reg["offset"])
+            b = f.read(1)
+            f.seek(reg["offset"])
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(SegmentCorruptError):
+            verify_segment(out)
+        with open(bin_path, "r+b") as f:  # restore the byte -> clean again
+            f.seek(reg["offset"])
+            f.write(bytes([b[0]]))
+        verify_segment(out)
+        np.testing.assert_array_equal(
+            ImmutableSegment.load(out, verify=True).column("city").packed, c.packed
+        )
+
     def test_scripted_crash_restart_mid_workload(self, tmp_path):
         """FaultPlan lifecycle rules: server0 crashes when server1 takes its
         2nd call, restarts on server1's 4th — queries stay exact throughout."""

@@ -1,0 +1,227 @@
+"""Bit-packed forward indexes (segment/packing.py): lane-width selection,
+pack/unpack round-trips (numpy and trace-level), segment build→save→load
+parity across lane widths and boundary cardinalities, device shipping of
+packed words, the stacked-table twin, and the pre-packing backward-compat
+path."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from pinot_tpu.segment import packing
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.segment.segment import BUILDER_VERSION, ImmutableSegment
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+
+
+def _dict_schema(nullable=False):
+    return Schema(
+        "t",
+        [
+            FieldSpec("k", DataType.STRING, nullable=nullable),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+
+
+def _dict_data(n, card, seed=0, null_rate=0.0):
+    assert n >= card
+    rng = np.random.default_rng(seed)
+    # every dictionary id appears at least once: boundary-cardinality tests
+    # need the EXACT cardinality, not a random subset
+    ids = np.concatenate([np.arange(card), rng.integers(0, card, n - card)])
+    rng.shuffle(ids)
+    vals = np.array([f"k{i:06d}" for i in ids], dtype=object)
+    if null_rate:
+        vals[rng.random(n) < null_rate] = None
+    return {"k": vals, "v": rng.integers(0, 1000, n)}
+
+
+class TestLaneSelection:
+    @pytest.mark.parametrize(
+        "card,bits",
+        [(1, 4), (16, 4), (17, 8), (256, 8), (257, 16), (65536, 16), (65537, 32)],
+    )
+    def test_boundary_cardinalities(self, card, bits):
+        assert packing.lane_bits(card) == bits
+
+
+class TestPackRoundTrip:
+    @pytest.mark.parametrize("bits", [4, 8, 16])
+    @pytest.mark.parametrize("n", [1, 7, 32, 1000])  # incl. tail-word cases
+    def test_numpy_round_trip(self, bits, n):
+        rng = np.random.default_rng(bits * 1000 + n)
+        codes = rng.integers(0, 1 << bits, n).astype(np.uint32)
+        words = packing.pack_codes(codes, bits)
+        assert words.dtype == np.uint32
+        assert words.shape[0] == -(-n // (32 // bits))
+        np.testing.assert_array_equal(packing.unpack_codes(words, bits, n), codes)
+
+    @pytest.mark.parametrize("bits", [4, 8, 16])
+    def test_jnp_unpack_matches_numpy(self, bits):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(bits)
+        n = 999
+        codes = rng.integers(0, 1 << bits, n).astype(np.uint32)
+        words = packing.pack_codes(codes, bits)
+        got = np.asarray(packing.unpack_codes_jnp(jnp.asarray(words), bits, n))
+        np.testing.assert_array_equal(got, codes.astype(np.int32))
+
+    def test_jnp_unpack_last_axis_2d(self):
+        """Stacked [S, W] layouts unpack along the last axis."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        codes = rng.integers(0, 16, 128).astype(np.uint32)
+        words = packing.pack_codes(codes, 4).reshape(2, 8)
+        got = np.asarray(packing.unpack_codes_jnp(jnp.asarray(words), 4, 64))
+        np.testing.assert_array_equal(got, codes.reshape(2, 64))
+
+    def test_rejects_unsupported_width(self):
+        with pytest.raises(ValueError):
+            packing.pack_codes(np.zeros(4, np.uint32), 5)
+        with pytest.raises(ValueError):
+            packing.unpack_codes(np.zeros(1, np.uint32), 3, 4)
+
+
+class TestSegmentRoundTrip:
+    @pytest.mark.parametrize(
+        "card,bits",
+        [(3, 4), (16, 4), (17, 8), (256, 8), (257, 16), (40000, 16)],
+    )
+    def test_build_save_load_parity(self, tmp_path, card, bits):
+        n = max(card * 2, 500)
+        schema, data = _dict_schema(), _dict_data(n, card, seed=card)
+        seg = build_segment(schema, data, "s0", output_dir=str(tmp_path / "s0"))
+        c = seg.column("k")
+        assert c.code_bits == (bits if bits < 32 else None)
+        assert c.packed is not None and c.packed.dtype == np.uint32
+        loaded = ImmutableSegment.load(str(tmp_path / "s0"), verify=True)
+        lc = loaded.column("k")
+        assert lc.code_bits == c.code_bits
+        np.testing.assert_array_equal(lc.codes, c.codes)
+        np.testing.assert_array_equal(lc.packed, c.packed)
+        np.testing.assert_array_equal(lc.decoded(), seg.column("k").decoded())
+
+    def test_wide_dictionary_stays_unpacked(self, tmp_path):
+        n, card = 140_000, 70_000  # needs >16 bits -> raw storage
+        schema, data = _dict_schema(), _dict_data(n, card, seed=9)
+        seg = build_segment(schema, data, "s0", output_dir=str(tmp_path / "s0"))
+        c = seg.column("k")
+        assert c.code_bits is None and c.packed is None
+        loaded = ImmutableSegment.load(str(tmp_path / "s0"), verify=True)
+        assert loaded.column("k").code_bits is None
+        np.testing.assert_array_equal(loaded.column("k").codes, c.codes)
+
+    def test_nullable_dict_column_round_trip(self, tmp_path):
+        schema = _dict_schema(nullable=True)
+        data = _dict_data(800, 20, seed=5, null_rate=0.15)
+        seg = build_segment(schema, data, "s0", output_dir=str(tmp_path / "s0"))
+        c = seg.column("k")
+        assert c.code_bits == 8 and c.nulls is not None and c.nulls.sum() > 0
+        loaded = ImmutableSegment.load(str(tmp_path / "s0"), verify=True)
+        lc = loaded.column("k")
+        np.testing.assert_array_equal(lc.nulls, c.nulls)
+        np.testing.assert_array_equal(lc.codes, c.codes)
+        np.testing.assert_array_equal(lc.packed, c.packed)
+
+    def test_builder_version_stamped(self, tmp_path):
+        from pinot_tpu.segment import store
+
+        schema, data = _dict_schema(), _dict_data(200, 10)
+        build_segment(schema, data, "s0", output_dir=str(tmp_path / "s0"))
+        meta, _ = store.read_segment(str(tmp_path / "s0"))
+        assert meta["builderVersion"] == BUILDER_VERSION == 2
+
+    def test_pre_packing_segment_loads_via_raw_path(self, tmp_path):
+        """A segment written before packing (no codeBits in column meta)
+        must load and decode unchanged through the raw forward index."""
+        schema, data = _dict_schema(), _dict_data(300, 10, seed=7)
+        seg = build_segment(schema, data, "s0")
+        # simulate the v1 builder: strip packing before save -> the .fwd
+        # region holds raw codes and col meta carries no codeBits
+        seg.columns["k"] = dataclasses.replace(
+            seg.columns["k"], code_bits=None, packed=None
+        )
+        seg.save(str(tmp_path / "s0"))
+        from pinot_tpu.segment import store
+
+        meta, _ = store.read_segment(str(tmp_path / "s0"))
+        km = meta["columns"][list(seg.columns).index("k")]  # positional meta
+        assert "codeBits" not in km
+        loaded = ImmutableSegment.load(str(tmp_path / "s0"), verify=True)
+        lc = loaded.column("k")
+        assert lc.code_bits is None and lc.packed is None
+        np.testing.assert_array_equal(lc.decoded(), seg.column("k").decoded())
+
+
+class TestDeviceShipping:
+    def test_to_device_packed_opt_in(self):
+        import jax
+
+        schema, data = _dict_schema(), _dict_data(400, 10)
+        seg = build_segment(schema, data, "s0")
+        plain = seg.to_device(columns=["k"])
+        assert "codes" in plain["k"] and "codes_packed" not in plain["k"]
+        packed = seg.to_device(columns=["k"], packed_codes=True)
+        assert "codes_packed" in packed["k"] and "codes" not in packed["k"]
+        w = np.asarray(jax.device_get(packed["k"]["codes_packed"]))
+        np.testing.assert_array_equal(
+            packing.unpack_codes(w, seg.column("k").code_bits, seg.num_docs),
+            np.asarray(seg.column("k").codes, dtype=np.uint32),
+        )
+
+    def test_plain_and_packed_entries_cached_separately(self):
+        schema, data = _dict_schema(), _dict_data(100, 10)
+        seg = build_segment(schema, data, "s0")
+        a = seg.to_device(columns=["k"])["k"]
+        b = seg.to_device(columns=["k"], packed_codes=True)["k"]
+        assert a is not b
+        assert seg.to_device(columns=["k"])["k"] is a  # cache hit per flavor
+        assert seg.to_device(columns=["k"], packed_codes=True)["k"] is b
+
+
+class TestStackedPacking:
+    def _stacked(self, n=2000, card=10, shards=8):
+        from pinot_tpu.parallel.stacked import StackedTable
+
+        schema, data = _dict_schema(), _dict_data(n, card, seed=1)
+        return StackedTable.build(schema, data, shards)
+
+    def test_build_packs_per_shard(self):
+        st = self._stacked()
+        c = st.columns["k"]
+        assert c.code_bits == 4
+        S, D = c.codes.shape
+        assert c.packed.shape == (S, D * 4 // 32)
+        for s in range(S):
+            np.testing.assert_array_equal(
+                packing.unpack_codes(c.packed[s], 4, D),
+                c.codes[s].astype(np.uint32),
+            )
+
+    def test_signature_keys_on_code_bits(self):
+        st = self._stacked()
+        sig_packed = st.signature()
+        st.columns["k"] = dataclasses.replace(
+            st.columns["k"], code_bits=None, packed=None
+        )
+        assert st.signature() != sig_packed
+
+    def test_to_device_packed_with_doc_slice(self):
+        import jax
+
+        st = self._stacked()
+        D = st.docs_per_shard
+        lo, hi = 32, D  # 32-aligned slice, as _batching produces
+        cols, _ = st.to_device(
+            columns=["k"], doc_slice=(lo, hi), packed_codes=True, with_valid=False
+        )
+        w = np.asarray(jax.device_get(cols["k"]["codes_packed"]))
+        assert w.shape == (st.num_shards, (hi - lo) * 4 // 32)
+        for s in range(st.num_shards):
+            np.testing.assert_array_equal(
+                packing.unpack_codes(w[s], 4, hi - lo),
+                st.columns["k"].codes[s, lo:hi].astype(np.uint32),
+            )

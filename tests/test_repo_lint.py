@@ -829,6 +829,77 @@ class TestW019RetryLoopDiscipline:
         assert sorted(set(_rules(src, threaded=True))) == ["W019"]
 
 
+class TestW020PackedWidenBeforeUnpack:
+    def test_flags_astype_on_packed_words_without_shift(self):
+        src = """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def scan_kernel(words_ref, o_ref):
+            packed = words_ref[...]
+            wide = packed.astype(jnp.int32)  # widens BEFORE the lane unpack
+            o_ref[...] = wide & 0xF
+
+        def run(x):
+            return pl.pallas_call(scan_kernel, out_shape=x)(x)
+        """
+        assert _rules(src) == ["W020"]
+
+    def test_flags_ref_read_named_words(self):
+        src = """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def scan_kernel(refs, o_ref):
+            key_words = refs[...]
+            o_ref[...] = key_words.astype(jnp.float32)
+
+        def run(x):
+            return pl.pallas_call(scan_kernel, out_shape=x)(x)
+        """
+        assert _rules(src) == ["W020"]
+
+    def test_quiet_when_shift_precedes_cast(self):
+        src = """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def scan_kernel(words_ref, o_ref):
+            packed = words_ref[...]
+            lanes = (packed[:, None] >> jnp.uint32(4)) & jnp.uint32(0xF)
+            o_ref[...] = lanes.astype(jnp.int32)  # cast AFTER the unpack
+
+        def run(x):
+            return pl.pallas_call(scan_kernel, out_shape=x)(x)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_unpacked_operand_cast(self):
+        src = """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def scan_kernel(key_ref, o_ref):
+            o_ref[...] = key_ref[...].astype(jnp.int32)  # plain codes, not packed
+
+        def run(x):
+            return pl.pallas_call(scan_kernel, out_shape=x)(x)
+        """
+        assert _rules(src) == []
+
+    def test_rule_scope_is_pallas_kernels_only(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        def host_helper(packed_words):
+            return packed_words.astype(jnp.int64)  # jit kernel, not Pallas
+
+        fn = jax.jit(host_helper)
+        """
+        assert _rules(src) == []
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

@@ -452,6 +452,109 @@ def _concurrent_qps_bench() -> dict:
     }
 
 
+def _working_set_sweep() -> dict:
+    """Tiered-storage capacity sweep (round-14 tentpole).
+
+    HBM is now a cost-aware cache over host RAM (segment/residency.py):
+    macro-batch slices are staged through an async double-buffered copy
+    stream and evicted by coldness when the budget fills.  This section
+    sizes the sequential-scan working set W empirically (resident bytes
+    after an unbounded-budget scan), then reruns the same group-by scan
+    with the cache budget at 2W / W / W/4 — i.e. the working set at
+    0.5x / 1x / 4x of HBM — and reports the rows/s degradation curve,
+    the prefetch-hit rate of the staging stream, and staging-stall time.
+    Every leg must be bit-exact against an untiered (hbm_cache_bytes=0,
+    full-pinning) reference: eviction churn may cost throughput, never
+    correctness.  bench_record lifts the 1x/4x rows/s and the 4x
+    prefetch-hit rate into the gate metrics.
+    """
+    import jax
+
+    from pinot_tpu.parallel.engine import DistributedEngine
+    from pinot_tpu.parallel.stacked import StackedTable
+    from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+    from pinot_tpu.utils.metrics import METRICS
+
+    rng = np.random.default_rng(7)
+    # capacity behaviour is about ratios, not scale — cap the table so the
+    # sweep stays cheap inside the CPU smoke run (BENCH_ROWS=1<<20)
+    n = min(N_ROWS, 1 << 22)
+    schema = Schema(
+        "ws",
+        [
+            FieldSpec("g", DataType.INT),
+            FieldSpec("m", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+    data = {
+        "g": rng.integers(0, 512, n).astype(np.int32),
+        "m": rng.integers(0, 1 << 20, n).astype(np.int64),
+    }
+    sql = "SELECT g, COUNT(*), SUM(m) FROM ws GROUP BY g ORDER BY g LIMIT 600"
+    ndev = len(jax.devices())
+    # ~16 macro-batches (~12 B/doc: packed g codes + raw m): the 4x leg
+    # keeps only ~4 slices resident, so the copy stream runs continuously
+    # while earlier batches scan — the double-buffering regime under test
+    launch_bytes = max(4096, (12 * max(n // ndev, 1)) // 16)
+
+    def build(cache_bytes):
+        eng = DistributedEngine(launch_bytes=launch_bytes, hbm_cache_bytes=cache_bytes)
+        eng.register_table("ws", StackedTable.build(schema, dict(data), eng.num_devices))
+        return eng
+
+    ref_eng = build(0)  # tiering disabled: the pre-r14 full-pinning path
+    ref_rows = ref_eng.query(sql).rows
+
+    probe = build(1 << 40)  # effectively unbounded budget: measures W
+    assert probe.query(sql).rows == ref_rows, "tiered probe diverged from untiered reference"
+    wset = int(probe.residency.resident_bytes)
+    probe.residency.shutdown()
+
+    iters = max(2, min(K_ITERS, 4))
+    legs = {}
+    for label, budget in (
+        ("0.5x", 2 * wset),
+        ("1x", wset + (64 << 10)),  # dict-page headroom: fully resident
+        ("4x", max(4 * launch_bytes, wset // 4)),
+    ):
+        eng = build(budget)
+        # cold pass pays compiles + the first staging wave; the timed loop
+        # measures the steady state each leg is meant to expose
+        assert eng.query(sql).rows == ref_rows, f"tiered {label} leg diverged"
+        h0 = METRICS.counter("engine.prefetchHits").value
+        s0 = METRICS.counter("engine.stagingStalls").value
+        st0 = METRICS.snapshot()["histograms"].get("residency.stagingStallMs", {})
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            assert eng.query(sql).rows == ref_rows, f"tiered {label} leg diverged"
+        wall = time.perf_counter() - t0
+        hits = METRICS.counter("engine.prefetchHits").value - h0
+        stalls = METRICS.counter("engine.stagingStalls").value - s0
+        st1 = METRICS.snapshot()["histograms"].get("residency.stagingStallMs", {})
+        stall_ms = st1.get("count", 0) * st1.get("meanMs", 0.0) - st0.get(
+            "count", 0
+        ) * st0.get("meanMs", 0.0)
+        snap = eng.residency.snapshot()
+        legs[label] = {
+            "budget_bytes": int(budget),
+            "rows_per_sec": round(n * iters / wall, 1),
+            "prefetch_hits": int(hits),
+            "staging_stalls": int(stalls),
+            "prefetch_hit_rate": round(hits / (hits + stalls), 3) if hits + stalls else 1.0,
+            "staging_stall_ms": round(max(stall_ms, 0.0), 3),
+            "evictions": snap["evictions"],
+            "bit_exact": True,
+        }
+        eng.residency.shutdown()
+    return {
+        "rows": n,
+        "working_set_bytes": wset,
+        "launch_bytes": int(launch_bytes),
+        "iters_per_leg": iters,
+        "legs": legs,
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -836,6 +939,7 @@ def main() -> None:
         "overload": _overload_bench(),
         "tail_latency": _tail_latency_bench(),
         "concurrent_qps": _concurrent_qps_bench(),
+        "working_set_sweep": _working_set_sweep(),
     }
     print(json.dumps(report))
 

@@ -131,6 +131,17 @@ words to full dtype before the predicate/accumulate — spilling the
 register-resident unpack back into a full-width HBM intermediate, which
 forfeits the bandwidth the packing bought.  Shift first (`_lane_unpack`),
 then cast the unpacked lanes.
+
+W021 guards the tiered-storage staging contract (segment/residency.py): a
+`jax.device_put(...)` whose shipped argument references a SEGMENT-SIZED
+operand (an identifier matching codes/packed/values/nulls/mv_lengths/
+column/segment) outside a staging-path function (name containing
+`to_device` or `stage`) is a synchronous, unbudgeted host->device copy on
+the serving path — it bypasses the residency manager's charge/evict
+accounting AND stalls the caller for the full PCIe transfer instead of
+riding the overlapped copy stream.  Small per-query params (literals,
+bitmap words, stacked scalar pytrees) are fine: the rule keys on the
+operand's name, not the call site.
 """
 from __future__ import annotations
 
@@ -155,6 +166,7 @@ RULES: Dict[str, str] = {
     "W018": "blocking call (sleep/device fence/socket I/O) inside an async batch-dispatch path",
     "W019": "retry/hedge loop re-issues a server call without bounded backoff or without the cancel-probe path",
     "W020": "packed words widened via .astype() in a Pallas kernel body before the lane unpack (shift first, then cast)",
+    "W021": "synchronous jax.device_put of a segment-sized array outside the staging stream (route through the residency manager's budgeted charge)",
     # interprocedural passes (analysis/races.py, analysis/device_sync.py —
     # run via analysis/engine.py over the whole package, not per-file):
     "W010": "lock-guarded attribute read/written without holding its lock",
@@ -1247,6 +1259,60 @@ def _check_w019(path: str, tree: ast.AST, findings: List[Finding]) -> None:
                 ))
 
 
+_W021_SEGMENT_OPERAND = re.compile(
+    r"codes|packed|values|nulls|mv_len|lengths|column|segment"
+)
+_W021_STAGING_SCOPE = re.compile(r"to_device|stage")
+
+
+def _w021_ships_segment_operand(node: ast.AST) -> bool:
+    """Any identifier in the shipped expression smells segment-sized."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and _W021_SEGMENT_OPERAND.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and _W021_SEGMENT_OPERAND.search(sub.attr):
+            return True
+    return False
+
+
+def _check_w021(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """W021: segment-sized `jax.device_put` outside the staging stream.
+
+    Tiered storage (segment/residency.py) requires every segment-shaped
+    host->device copy to run under a staging OWNER: charged against the
+    residency budget (so eviction keeps HBM bounded) and issued on/overlapped
+    with the copy stream.  A bare device_put of column arrays anywhere else
+    on the serving path is an unbudgeted pin plus a synchronous PCIe stall.
+    Functions whose name marks them as the staging path (`to_device`,
+    `*stage*`) are exempt — they ARE the budgeted copy engine."""
+
+    def visit(node: ast.AST, exempt: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_exempt = exempt
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_exempt = bool(_W021_STAGING_SCOPE.search(child.name))
+            if isinstance(child, ast.Call) and not exempt:
+                f = child.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "device_put"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "jax"
+                    and child.args
+                    and _w021_ships_segment_operand(child.args[0])
+                ):
+                    findings.append(Finding(
+                        path, child.lineno, "W021",
+                        "segment-sized jax.device_put outside the staging "
+                        "stream — unbudgeted HBM pin and a synchronous PCIe "
+                        "copy on the serving path; route it through "
+                        "to_device/residency staging",
+                    ))
+            visit(child, child_exempt)
+
+    visit(tree, False)
+
+
 def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> List[Finding]:
     """Lint one module's source.  `threaded` enables the cluster/-scoped
     rules (W004 shared-state races, W006 swallowed exceptions, W015
@@ -1276,6 +1342,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
     _check_w008(path, tree, findings)
     _check_w016(path, tree, findings)
     _check_w017(path, tree, findings)
+    _check_w021(path, tree, findings)
     if threaded:
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)

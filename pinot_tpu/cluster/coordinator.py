@@ -289,6 +289,18 @@ class Coordinator:
                 server.budget = ResourceBudget(
                     hbm, gauge=f"server.reservedBytes.{server.name}"
                 )
+        # attach the tiered-storage residency manager (r17 tentpole): HBM
+        # becomes a cost-aware cache over the segments' host arrays, so
+        # ASSIGNMENT NO LONGER ASSUMES FULL PINNING — a server can own a
+        # working set larger than device memory and page it through the
+        # cache budget with staged prefetch.  Its cache ledger is a
+        # SEPARATE ResourceBudget from server.budget: reservations meter
+        # in-flight scatter windows, the residency budget meters resident
+        # cached bytes (PINOT_TPU_HBM_CACHE_BYTES=0 disables tiering).
+        if getattr(server, "residency", None) is None:
+            from pinot_tpu.segment.residency import default_residency
+
+            server.residency = default_residency(name=f"residency.{server.name}")
         with self._membership_lock:
             self.servers[server.name] = server
             self.live.add(server.name)
@@ -732,10 +744,16 @@ class Coordinator:
             servers = dict(self.servers)
         # per-server HBM reservation occupancy (admission ledger view)
         reserved = {}
+        residency = {}
         for name, srv in servers.items():
             budget = getattr(srv, "budget", None)
             if budget is not None:
                 reserved[name] = budget.snapshot()
+            res = getattr(srv, "residency", None)
+            if res is not None:
+                # tiered-storage cache view: resident bytes, hit/miss/
+                # eviction/prefetch counters per server
+                residency[name] = res.snapshot()
         out: Dict[str, Dict] = {}
         for table, meta in self.tables.items():
             under = []
@@ -748,5 +766,6 @@ class Coordinator:
                 "underReplicated": under,
                 "liveServers": sorted(live),
                 "reservedBytes": reserved,
+                "residency": residency,
             }
         return out

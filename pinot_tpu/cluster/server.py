@@ -45,7 +45,10 @@ def _segment_bytes(segment: ImmutableSegment) -> int:
 
 
 class ServerInstance:
-    def __init__(self, name: str, device=None, fault_plan=None, budget=None, data_dir=None):
+    def __init__(
+        self, name: str, device=None, fault_plan=None, budget=None, data_dir=None,
+        residency=None,
+    ):
         self.name = name
         self.device = device
         # table -> {segment name -> segment}
@@ -57,6 +60,15 @@ class ServerInstance:
         # concurrent queries can't jointly overcommit device memory.  None
         # disables tracking; the coordinator attaches one at registration.
         self.budget: Optional[ResourceBudget] = budget
+        # tiered storage (segment/residency.py): when attached, HBM is a
+        # byte-budgeted CACHE over the segments' host arrays — scatter
+        # calls reserve only the pipeline window (not the full working
+        # set), segment columns page through the residency budget with
+        # cost-aware eviction, and the next segment's columns prefetch on
+        # the staging thread while the current kernel runs.  None keeps
+        # the legacy pin-everything path.  The coordinator attaches one
+        # at registration (PINOT_TPU_HBM_CACHE_BYTES=0 disables).
+        self.residency = residency
         # local segment cache dir for deep-store restores (tempdir fallback)
         self.data_dir = data_dir
         # process-death simulation: True between crash() and boot() — every
@@ -109,6 +121,12 @@ class ServerInstance:
     def drop_segment(self, table: str, seg_name: str) -> None:
         seg = self.segments.get(table, {}).pop(seg_name, None)
         if seg is not None:
+            if self.residency is not None:
+                # uncharge the cache budget AND drop the device entry;
+                # the evict callback clears raw + #packed flavors together
+                self.residency.evict(seg.device_group(self.device))
+            # idempotent with the residency evict; also clears legacy pins
+            seg.evict_device(self.device)
             METRICS.gauge(f"server.segmentBytes.{table}").add(-_segment_bytes(seg))
             self.metrics.gauge(f"server.segmentBytes.{table}").add(-_segment_bytes(seg))
 
@@ -161,12 +179,30 @@ class ServerInstance:
             # working-set estimate for the batch, reserved all-or-nothing
             # BEFORE any kernel launches (host-side arithmetic only — no
             # device values touched, so the warm path stays sync-free)
-            need = 0
+            est = []
             for name in seg_names:
                 seg = self.get_segment(ctx.table, name)
                 if seg is not None:
-                    need += estimate_segment_bytes(ctx, seg, _needed_columns(ctx, seg))
-            ticket = self.budget.reserve(need, what=f"scatter to server {self.name}")
+                    est.append(estimate_segment_bytes(ctx, seg, _needed_columns(ctx, seg)))
+            if self.residency is not None:
+                # tiered storage: HBM is a cache, so a scatter only needs
+                # its PIPELINE WINDOW resident at once (current segment +
+                # the one prefetching behind it) — the residency manager
+                # pages the rest through the budget as the scan advances.
+                # Working sets that exceed free-but-not-total budget park
+                # as a staged fetch instead of 503ing; a window that
+                # exceeds the whole budget cannot fit even transiently
+                # and still raises ReservationError.
+                need = max(
+                    (sum(est[i : i + 2]) for i in range(len(est))), default=0
+                )
+                ticket = self.budget.reserve_or_wait(
+                    need, what=f"scatter to server {self.name}", deadline=deadline
+                )
+            else:
+                ticket = self.budget.reserve(
+                    sum(est), what=f"scatter to server {self.name}"
+                )
         try:
             plan = self.fault_plan
             if plan is not None:
@@ -180,8 +216,11 @@ class ServerInstance:
             results = []
             pending = []
             with trace.span("dispatch") as dsp:
+                # host-side pre-filter FIRST: range/bloom metadata prunes
+                # cold segments before any staging, so a pruned segment
+                # never enters the host->device copy stream
+                scan = []
                 for name in seg_names:
-                    self._check_budget(deadline, cancelled=len(pending), cancel=cancel)
                     seg = self.get_segment(ctx.table, name)
                     if seg is not None and plan is not None and plan.segment_dropped(self.name, ctx.table, name):
                         seg = None
@@ -194,9 +233,26 @@ class ServerInstance:
                     if executor.prune_segment(ctx, seg):
                         stats.num_segments_pruned += 1
                         continue
+                    scan.append(seg)
+                for k, seg in enumerate(scan):
+                    self._check_budget(deadline, cancelled=len(pending), cancel=cancel)
+                    if self.residency is not None and k + 1 < len(scan):
+                        # double-buffer: stage segment k+1's columns on the
+                        # residency staging thread while k dispatches/runs
+                        nxt = scan[k + 1]
+                        self.residency.submit(
+                            nxt.to_device,
+                            device=self.device,
+                            columns=_needed_columns(ctx, nxt),
+                            packed_codes=True,
+                            residency=self.residency,
+                            prefetch=True,
+                        )
                     # pipelined: dispatch all kernels async, then drain (executor.py)
                     with trace.span(f"launch:{seg.name}") as lsp:
-                        st = executor.launch_segment(ctx, seg, device=self.device)
+                        st = executor.launch_segment(
+                            ctx, seg, device=self.device, residency=self.residency
+                        )
                         pending.append(st)
                     if lsp is not None and st[0] == "pending":
                         # per-operator cost model for EXPLAIN ANALYZE / traces
@@ -309,14 +365,29 @@ class ServerInstance:
         if self.budget is not None:
             # members share one plan shape, so the working set is the
             # SHARED column pytree — reserved once, not once per member
-            need = 0
+            est = []
             for name in seg_names:
                 seg = self.get_segment(ctxs[0].table, name)
                 if seg is not None:
-                    need += estimate_segment_bytes(
-                        ctxs[0], seg, _needed_columns(ctxs[0], seg)
+                    est.append(
+                        estimate_segment_bytes(
+                            ctxs[0], seg, _needed_columns(ctxs[0], seg)
+                        )
                     )
-            ticket = self.budget.reserve(need, what=f"batched scatter to server {self.name}")
+            if self.residency is not None:
+                # pipeline-window reservation (see execute): the cache
+                # pages segments through the budget, so only the window
+                # must be jointly resident
+                need = max(
+                    (sum(est[i : i + 2]) for i in range(len(est))), default=0
+                )
+                ticket = self.budget.reserve_or_wait(
+                    need, what=f"batched scatter to server {self.name}"
+                )
+            else:
+                ticket = self.budget.reserve(
+                    sum(est), what=f"batched scatter to server {self.name}"
+                )
         try:
             plan = self.fault_plan
             if plan is not None:
@@ -359,13 +430,15 @@ class ServerInstance:
                     with trace.span(f"launch:{seg.name}", members=len(scan)):
                         if len(scan) == 1:
                             st = executor.launch_segment(
-                                ctxs[scan[0]], seg, device=self.device
+                                ctxs[scan[0]], seg, device=self.device,
+                                residency=self.residency,
                             )
                             pending.append((st, scan))
                         else:
                             try:
                                 st = executor.launch_segment_batch(
-                                    [ctxs[i] for i in scan], seg, device=self.device
+                                    [ctxs[i] for i in scan], seg, device=self.device,
+                                    residency=self.residency,
                                 )
                                 pending.append((st, scan))
                             except executor.BatchShapeError:
@@ -375,7 +448,8 @@ class ServerInstance:
                                     pending.append(
                                         (
                                             executor.launch_segment(
-                                                ctxs[i], seg, device=self.device
+                                                ctxs[i], seg, device=self.device,
+                                                residency=self.residency,
                                             ),
                                             [i],
                                         )

@@ -200,6 +200,7 @@ class DistributedEngine:
         axis: str = "seg",
         launch_bytes: Optional[int] = None,
         pipeline_depth: Optional[int] = None,
+        hbm_cache_bytes: Optional[int] = None,
     ):
         import os
 
@@ -245,6 +246,24 @@ class DistributedEngine:
             if pipeline_depth is not None
             else int(os.environ.get("PINOT_TPU_PIPELINE_DEPTH", "2"))
         )
+        # tiered segment storage (segment/residency.py): HBM is a byte-
+        # budgeted cache over the host arrays.  The staging stream copies
+        # batch k+1's slices while batch k computes — the generalization of
+        # pipeline_depth from "launch next kernel" to "stage next segment".
+        # PINOT_TPU_HBM_CACHE_BYTES sizes the cache (0 disables tiering and
+        # restores the legacy pin-everything path).
+        from pinot_tpu.segment.residency import default_residency
+
+        if hbm_cache_bytes is not None and hbm_cache_bytes > 0:
+            from pinot_tpu.cluster.admission import ResourceBudget
+
+            self.residency = default_residency(
+                budget=ResourceBudget(hbm_cache_bytes, gauge="residency.reservedBytes")
+            )
+        elif hbm_cache_bytes is not None:
+            self.residency = None
+        else:
+            self.residency = default_residency()
 
     @property
     def num_devices(self) -> int:
@@ -990,14 +1009,11 @@ class DistributedEngine:
             p[k] = np.ascontiguousarray(w[:, :, wlo:whi]).reshape(w.shape[0], -1)
         return p
 
-    def device_batches(self, plan: _DistPlan, stacked) -> List[Tuple[Dict, Dict]]:
-        """Device-placed (cols, params) per macro-batch launch (bench.py's
-        marginal-timing hook shares this with _run).
-
-        Batch-invariant params stage ONCE per query: only the launch-schedule
-        scalars (__boff__/__fresh__) and the doc-sliced row-sharded bitmap
-        words differ between launches, so the shared device_put cost no
-        longer scales with the launch count."""
+    def _shared_params(self, plan: _DistPlan):
+        """Batch-invariant params stage ONCE per query: only the launch-
+        schedule scalars (__boff__/__fresh__) and the doc-sliced row-sharded
+        bitmap words differ between launches, so the shared device_put cost
+        does not scale with the launch count."""
         repl = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P(self.axis, None))
         shared = {
@@ -1005,20 +1021,37 @@ class DistributedEngine:
             for k, v in plan.params.items()
             if k not in plan.row_sharded_params and k not in ("__boff__", "__fresh__")
         }
-        out = []
-        for off, fresh in plan.batch_offsets:
-            cols, _ = stacked.to_device(
-                self.mesh, self.axis, plan.needed_columns,
-                doc_slice=(off, off + plan.batch_docs), with_valid=False,
-                packed_codes=True,
-            )
-            params = dict(shared)
-            for k, v in self.batch_params(plan, off, fresh).items():
-                if k in shared:
-                    continue
-                params[k] = jax.device_put(v, shard if k in plan.row_sharded_params else repl)
-            out.append((cols, params))
-        return out
+        return shared, repl, shard
+
+    def _stage_batch(
+        self, plan: _DistPlan, stacked, j: int, shared, repl, shard, prefetch: bool = False
+    ) -> Tuple[Dict, Dict]:
+        """Stage macro-batch j's device inputs: the table slice rides the
+        residency cache (budgeted, evictable), per-batch params ship fresh.
+        Runs on the residency staging stream when called with prefetch."""
+        off, fresh = plan.batch_offsets[j]
+        cols, _ = stacked.to_device(
+            self.mesh, self.axis, plan.needed_columns,
+            doc_slice=(off, off + plan.batch_docs), with_valid=False,
+            packed_codes=True, residency=self.residency, prefetch=prefetch,
+        )
+        params = dict(shared)
+        for k, v in self.batch_params(plan, off, fresh).items():
+            if k in shared:
+                continue
+            params[k] = jax.device_put(v, shard if k in plan.row_sharded_params else repl)
+        return cols, params
+
+    def device_batches(self, plan: _DistPlan, stacked) -> List[Tuple[Dict, Dict]]:
+        """Device-placed (cols, params) per macro-batch launch (bench.py's
+        marginal-timing hook shares this with _run; _run itself stages
+        lazily through the prefetch stream instead of materializing the
+        whole list)."""
+        shared, repl, shard = self._shared_params(plan)
+        return [
+            self._stage_batch(plan, stacked, j, shared, repl, shard)
+            for j in range(len(plan.batch_offsets))
+        ]
 
     @staticmethod
     def _combine_partials(parts_list):
@@ -1073,9 +1106,52 @@ class DistributedEngine:
         batch_outs = []
         pending: List[Any] = []
         launch_rows = stacked.num_shards * plan.batch_docs  # rows per launch
+        n_batches = len(plan.batch_offsets)
+        # Staging pipeline: with a residency manager attached, batch j+1's
+        # host->device copies run on the residency staging thread while
+        # batch j computes — the "stage next segment" generalization of the
+        # launch pipeline below.  Without one (tiering disabled) staging is
+        # inline, restoring the legacy pin-everything behaviour.  The single
+        # staging worker keeps copies FIFO, so consuming j never waits
+        # behind a copy issued for j+1.
+        shared, repl, shard = self._shared_params(plan)
+        use_stream = self.residency is not None and n_batches > 1
+        staged: Dict[int, Any] = {}
+
+        def _ensure(j: int, prefetch: bool) -> None:
+            if j >= n_batches or j in staged:
+                return
+            if use_stream:
+                staged[j] = self.residency.submit(
+                    self._stage_batch, plan, stacked, j, shared, repl, shard, prefetch
+                )
+            else:
+                staged[j] = self._stage_batch(plan, stacked, j, shared, repl, shard)
+
+        def _consume(j: int) -> Tuple[Dict, Dict]:
+            item = staged.pop(j)
+            if not use_stream:
+                return item
+            if item.done():
+                METRICS.counter("engine.prefetchHits").inc()
+                return item.result()
+            # the copy stream is behind the compute stream: timed stall
+            tw0 = time.perf_counter()
+            out = item.result()
+            METRICS.counter("engine.stagingStalls").inc()
+            METRICS.histogram("residency.stagingStallMs").update(
+                (time.perf_counter() - tw0) * 1000.0
+            )
+            return out
+
         tl0 = time.perf_counter()
         with trace.span("launches") as lsp:
-            for i, (cols, params) in enumerate(self.device_batches(plan, stacked)):
+            _ensure(0, False)
+            for i in range(n_batches):
+                for j in range(i + 1, min(i + 1 + depth, n_batches)):
+                    _ensure(j, True)
+                with trace.span(f"stage:{i}"):
+                    cols, params = _consume(i)
                 first_dispatch = i == 0 and plan.cost is None
                 if first_dispatch:
                     # cost model captured ONCE per cached plan (per LAUNCH —

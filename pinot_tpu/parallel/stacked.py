@@ -14,6 +14,7 @@ addable — the combine is literally `lax.psum`.  Pinot pays a keyed hash merge
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -113,6 +114,14 @@ class StackedTable:
         # (query/filter.py shard-aware params)
         self.indexes: Dict[str, Dict[str, Any]] = indexes or {}
         self._device_cache: Dict[Any, Any] = {}
+        # guards _device_cache/_group_keys (shared by aliased_view facades);
+        # NEVER held across a device copy — staging owners copy lock-free
+        # and publish in one critical section
+        self._device_lock = threading.Lock()
+        # residency cache-group -> the cache keys it charged: one doc-slice
+        # of the table is the eviction unit, and ALL its flavors (raw,
+        # #packed, valid words, dictionaries it staged) drop together
+        self._group_keys: Dict[Any, set] = {}
         # Per-instance nonce in signature(): compiled plans bake ROW-DATA
         # dependent params (sorted doc ranges, index bitmap words), which
         # dictionary fingerprints alone cannot distinguish — two tables with
@@ -340,62 +349,86 @@ class StackedTable:
         )
 
     # -- device residency ----------------------------------------------
-    def to_device(
-        self,
-        mesh=None,
-        axis: str = "seg",
-        columns: Optional[List[str]] = None,
-        doc_slice: Optional[Tuple[int, int]] = None,
-        with_valid: bool = True,
-        packed_codes: bool = False,
-    ):
-        """Shard row arrays over the mesh axis; dictionaries replicate.
+    def _use_packed(self, c: StackedColumn, sl, packed_codes: bool) -> bool:
+        # packed shipping needs lane-aligned doc offsets (macro-batch
+        # offsets are 32-aligned by _batching, so this always holds there)
+        return bool(
+            packed_codes
+            and c.packed is not None
+            and sl[0] % (32 // c.code_bits) == 0
+            and sl[1] % (32 // c.code_bits) == 0
+        )
 
-        Returns (cols_pytree, valid) of jax arrays with NamedSharding — the
-        input side of the shard_map combine kernel (parallel/engine.py).
+    @staticmethod
+    def _col_key(c: StackedColumn, sl, use_packed: bool):
+        # cache by BACKING-ARRAY identity, not name: self-join facades
+        # (aliased_view) rename columns but share the numpy storage —
+        # identity keys mean one HBM copy serves every alias
+        arr_id = id(c.codes if c.codes is not None else c.values)
+        return (arr_id, sl, "#packed") if use_packed else (arr_id, sl)
 
-        doc_slice=(lo, hi) ships only columns [:, lo:hi] of the [S, D] row
-        arrays — the macro-batch launch path (parallel/engine.py batching):
-        at 1B rows a single launch's while-loop capture copy alone exceeds
-        HBM, so the engine slices the doc axis into batches and combines
-        the table-sized partials across launches."""
+    def device_group(self, mesh, sl) -> Tuple:
+        """Residency cache-group key: ONE doc-slice of this table on one
+        mesh.  Slices evict independently (a 4x-budget working set must be
+        able to rotate through the cache), but all flavors of a slice drop
+        as a unit."""
+        return ("stacked", id(self), id(mesh), sl)
+
+    def _plan_missing(self, mesh, cols, sl, packed_codes, with_valid):
+        """(missing column specs, valid missing?, bytes to charge)."""
+        span = sl[1] - sl[0]
+        need = []
+        nbytes = 0
+        need_valid = False
+        with self._device_lock:
+            cache = self._device_cache.get(id(mesh), {})
+            for cname in cols:
+                c = self.columns[cname]
+                use_packed = self._use_packed(c, sl, packed_codes)
+                ck = self._col_key(c, sl, use_packed)
+                if ck in cache:
+                    continue
+                dkey = cached_dict = None
+                if c.codes is not None and c.dictionary is not None:
+                    dvals = c.dictionary.device_values()
+                    if dvals is not None:
+                        dkey = (id(c.dictionary), "dict")
+                        cached_dict = cache.get(dkey)
+                        if cached_dict is None:
+                            nbytes += dvals.nbytes
+                        else:
+                            dkey = None  # already staged (and charged) once
+                if use_packed:
+                    f = 32 // c.code_bits
+                    nbytes += c.packed[:, sl[0] // f : sl[1] // f].nbytes
+                elif c.codes is not None:
+                    nbytes += c.codes[:, sl[0] : sl[1]].nbytes
+                for arr in (c.values, c.nulls, c.mv_lengths):
+                    if arr is not None:
+                        nbytes += arr.itemsize * arr.shape[0] * span * (
+                            int(np.prod(arr.shape[2:])) if arr.ndim > 2 else 1
+                        )
+                need.append((cname, ck, use_packed, dkey, cached_dict))
+            if with_valid:
+                vk = (id(self.valid), sl)
+                if vk not in cache:
+                    need_valid = True
+                    nbytes += self.valid[:, sl[0] : sl[1]].nbytes
+        return need, need_valid, nbytes
+
+    def _stage_slice(self, need, need_valid, sl, row_sharding, rep_sharding):
+        """Host->device copies for one slice's missing entries (NO locks
+        held — this is the staging-stream body)."""
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        if mesh is None:
-            from pinot_tpu.parallel.mesh import default_mesh
-
-            mesh = default_mesh(axis)
-        row_sharding = NamedSharding(mesh, P(axis, None))
-        rep_sharding = NamedSharding(mesh, P())
-        cache = self._device_cache.setdefault(id(mesh), {})
-        cols = columns or list(self.columns)
-        sl = doc_slice if doc_slice is not None else (0, self.docs_per_shard)
 
         def _rows(a: np.ndarray) -> np.ndarray:
             if sl == (0, self.docs_per_shard):
                 return a
             return np.ascontiguousarray(a[:, sl[0] : sl[1]])
 
-        out: Dict[str, Dict[str, Any]] = {}
-        for cname in cols:
+        staged: Dict[Any, Any] = {}
+        for cname, ck, use_packed, dkey, cached_dict in need:
             c = self.columns[cname]
-            # cache by BACKING-ARRAY identity, not name: self-join facades
-            # (aliased_view) rename columns but share the numpy storage —
-            # identity keys mean one HBM copy serves every alias
-            arr_id = id(c.codes if c.codes is not None else c.values)
-            # packed shipping needs lane-aligned doc offsets (macro-batch
-            # offsets are 32-aligned by _batching, so this always holds there)
-            use_packed = bool(
-                packed_codes
-                and c.packed is not None
-                and sl[0] % (32 // c.code_bits) == 0
-                and sl[1] % (32 // c.code_bits) == 0
-            )
-            ck = (arr_id, sl, "#packed") if use_packed else (arr_id, sl)
-            if ck in cache:
-                out[cname] = cache[ck]
-                continue
             entry: Dict[str, Any] = {}
             if use_packed:
                 f = 32 // c.code_bits
@@ -406,36 +439,175 @@ class StackedTable:
             if c.codes is not None:
                 if not use_packed:
                     entry["codes"] = jax.device_put(_rows(c.codes), row_sharding)
-                dkey = (id(c.dictionary), "dict")
-                dvals = c.dictionary.device_values()
-                if dvals is not None:
-                    if dkey not in cache:
-                        cache[dkey] = jax.device_put(dvals, rep_sharding)
-                    entry["dict"] = cache[dkey]
+                if dkey is not None:
+                    dvals = c.dictionary.device_values()
+                    dput = jax.device_put(dvals, rep_sharding)
+                    staged[dkey] = dput
+                    entry["dict"] = dput
+                elif cached_dict is not None:
+                    entry["dict"] = cached_dict
             if c.values is not None:
                 entry["values"] = jax.device_put(_rows(c.values), row_sharding)
             if c.nulls is not None:
                 entry["nulls"] = jax.device_put(_rows(c.nulls), row_sharding)
             if c.mv_lengths is not None:
                 entry["lengths"] = jax.device_put(_rows(c.mv_lengths), row_sharding)
-            cache[ck] = entry
-            out[cname] = entry
-        if not with_valid:
-            # distributed-engine path: validity is computed IN-KERNEL from
-            # static num_docs (padding is always trailing in the global flat
-            # doc space by construction) — at 1B rows the [S, D] bool buffer
-            # plus its while-loop capture copy is ~2GB of HBM for a mask the
-            # kernel can derive from an iota compare.
-            return out, None
-        vk = (id(self.valid), sl)
-        if vk not in cache:
-            cache[vk] = jax.device_put(_rows(self.valid), row_sharding)
-        return out, cache[vk]
+            staged[ck] = entry
+        if need_valid:
+            staged[(id(self.valid), sl)] = jax.device_put(_rows(self.valid), row_sharding)
+        return staged
+
+    def _publish(self, mesh, group, staged) -> None:
+        """First-wins publish + group-key registration in ONE critical
+        section, so eviction can drop exactly this group's flavors."""
+        with self._device_lock:
+            cache = self._device_cache.setdefault(id(mesh), {})
+            for k, v in staged.items():
+                cache.setdefault(k, v)
+            self._group_keys.setdefault(group, set()).update(staged.keys())
+
+    def _assemble(self, mesh, cols, sl, packed_codes, with_valid):
+        """Read the slice pytree in ONE critical section; None if a racing
+        eviction removed any needed entry — callers re-stage the whole
+        group, never observing a half-evicted slice."""
+        with self._device_lock:
+            cache = self._device_cache.get(id(mesh), {})
+            out: Dict[str, Dict[str, Any]] = {}
+            for cname in cols:
+                c = self.columns[cname]
+                ck = self._col_key(c, sl, self._use_packed(c, sl, packed_codes))
+                if ck not in cache:
+                    return None
+                out[cname] = cache[ck]
+            if not with_valid:
+                # distributed-engine path: validity is computed IN-KERNEL
+                # from static num_docs (padding is always trailing in the
+                # global flat doc space by construction) — at 1B rows the
+                # [S, D] bool buffer plus its while-loop capture copy is
+                # ~2GB of HBM for a mask the kernel derives from an iota
+                # compare.
+                return out, None
+            vk = (id(self.valid), sl)
+            if vk not in cache:
+                return None
+            return out, cache[vk]
+
+    def evict_slice(self, mesh, sl) -> None:
+        """Atomic flavor invalidation for one slice group: every cache key
+        the group charged — raw, #packed, valid, dictionaries it staged —
+        drops in one critical section (residency eviction callback)."""
+        group = self.device_group(mesh, sl)
+        with self._device_lock:
+            keys = self._group_keys.pop(group, set())
+            cache = self._device_cache.get(id(mesh), {})
+            for k in keys:
+                cache.pop(k, None)
+
+    def to_device(
+        self,
+        mesh=None,
+        axis: str = "seg",
+        columns: Optional[List[str]] = None,
+        doc_slice: Optional[Tuple[int, int]] = None,
+        with_valid: bool = True,
+        packed_codes: bool = False,
+        residency=None,
+        prefetch: bool = False,
+        query_id: Optional[str] = None,
+    ):
+        """Shard row arrays over the mesh axis; dictionaries replicate.
+
+        Returns (cols_pytree, valid) of jax arrays with NamedSharding — the
+        input side of the shard_map combine kernel (parallel/engine.py).
+
+        doc_slice=(lo, hi) ships only columns [:, lo:hi] of the [S, D] row
+        arrays — the macro-batch launch path (parallel/engine.py batching):
+        at 1B rows a single launch's while-loop capture copy alone exceeds
+        HBM, so the engine slices the doc axis into batches and combines
+        the table-sized partials across launches.
+
+        With `residency` (segment/residency.py) the device cache is a
+        byte-budgeted tier over the host arrays: each doc-slice is a cache
+        group that charges the residency budget before copying (evicting
+        cost-ranked victim slices to make room), at most one thread stages
+        a group while the rest park on its event, and `prefetch=True` marks
+        a stage issued ahead of need (the engine's double-buffered copy
+        stream) for the prefetch-hit accounting."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if mesh is None:
+            from pinot_tpu.parallel.mesh import default_mesh
+
+            mesh = default_mesh(axis)
+        row_sharding = NamedSharding(mesh, P(axis, None))
+        rep_sharding = NamedSharding(mesh, P())
+        cols = columns or list(self.columns)
+        sl = doc_slice if doc_slice is not None else (0, self.docs_per_shard)
+        group = self.device_group(mesh, sl)
+
+        if residency is None:
+            # legacy pin-everything path: no budget, no eviction
+            while True:
+                need, need_valid, _ = self._plan_missing(
+                    mesh, cols, sl, packed_codes, with_valid
+                )
+                if need or need_valid:
+                    staged = self._stage_slice(need, need_valid, sl, row_sharding, rep_sharding)
+                    self._publish(mesh, group, staged)
+                out = self._assemble(mesh, cols, sl, packed_codes, with_valid)
+                if out is not None:
+                    return out
+
+        from pinot_tpu.segment import residency as res_mod
+        from pinot_tpu.utils.crashpoints import crash_point
+
+        while True:
+            need, need_valid, _ = self._plan_missing(mesh, cols, sl, packed_codes, with_valid)
+            st, entry = residency.begin_stage(
+                group,
+                self.schema.name,
+                lambda: self.evict_slice(mesh, sl),
+                prefetch=prefetch,
+            )
+            if st == res_mod.WAIT:
+                residency.wait(entry)
+                continue
+            if st == res_mod.HIT:
+                if not need and not need_valid:
+                    out = self._assemble(mesh, cols, sl, packed_codes, with_valid)
+                    if out is not None:
+                        return out
+                    continue  # evicted between plan and read: re-stage
+                st2, entry2 = residency.begin_grow(group)
+                if st2 == res_mod.WAIT:
+                    residency.wait(entry2)
+                    continue
+                if st2 == res_mod.RETRY:
+                    continue
+            # OWN: charge, copy (no locks held), publish, commit
+            try:
+                need, need_valid, nbytes = self._plan_missing(
+                    mesh, cols, sl, packed_codes, with_valid
+                )
+                residency.charge(group, nbytes, query_id=query_id)
+                crash_point("segment.stage.after_charge")
+                staged = self._stage_slice(need, need_valid, sl, row_sharding, rep_sharding)
+                crash_point("segment.stage.after_copy")
+                self._publish(mesh, group, staged)
+            except BaseException:
+                residency.abort_stage(group)
+                raise
+            residency.finish_stage(group)
+            out = self._assemble(mesh, cols, sl, packed_codes, with_valid)
+            if out is not None:
+                return out
 
     def release_device(self) -> None:
         # in-place: self-join facades (aliased_view) share this dict by
         # reference — rebinding would leave their references pinning HBM
-        self._device_cache.clear()
+        with self._device_lock:
+            self._device_cache.clear()
+            self._group_keys.clear()
 
     # -- self-join facades ----------------------------------------------
     def aliased_view(self, alias: str) -> "StackedTable":
@@ -463,7 +635,10 @@ class StackedTable:
             for kind, by_col in self.indexes.items()
         }
         t = StackedTable(schema, cols, self.valid, self.num_docs, indexes=idx)
-        t._device_cache = self._device_cache
+        t._device_lock = self._device_lock
+        with self._device_lock:
+            t._device_cache = self._device_cache
+            t._group_keys = self._group_keys
         return t
 
     # -- host decode (selection gather) ---------------------------------

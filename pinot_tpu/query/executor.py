@@ -94,7 +94,9 @@ def prune_segment(ctx: QueryContext, segment: ImmutableSegment) -> bool:
 # ---------------------------------------------------------------------------
 # Execution
 # ---------------------------------------------------------------------------
-def launch_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
+def launch_segment(
+    ctx: QueryContext, segment: ImmutableSegment, device=None, residency=None
+):
     """Phase 1 of pipelined execution: plan, ship inputs, and DISPATCH the
     segment kernel (jax dispatch is asynchronous — the call returns as soon
     as the work is enqueued).  Returns an opaque pending state for
@@ -121,7 +123,8 @@ def launch_segment(ctx: QueryContext, segment: ImmutableSegment, device=None):
     plan = planner.plan_segment(ctx, segment)
     stats.filter_index_uses = tuple(plan.index_uses)
     cols = segment.to_device(
-        device=device, columns=plan.needed_columns, packed_codes=True
+        device=device, columns=plan.needed_columns, packed_codes=True,
+        residency=residency,
     )
     params = {k: jax.device_put(v, device) for k, v in plan.params.items()}
     first_launch = plan.cost is None
@@ -273,7 +276,9 @@ def _batch_fn_cache():
 _BATCH_FN_CACHE = None
 
 
-def launch_segment_batch(ctxs: List[QueryContext], segment: ImmutableSegment, device=None):
+def launch_segment_batch(
+    ctxs: List[QueryContext], segment: ImmutableSegment, device=None, residency=None
+):
     """Dispatch N same-shape queries over one segment as a SINGLE vmapped
     kernel launch: member literal-parameter pytrees stack along a leading
     `query` axis (r9 made literals device args, so stacking needs no
@@ -311,7 +316,8 @@ def launch_segment_batch(ctxs: List[QueryContext], segment: ImmutableSegment, de
     if n < width:
         params_list = params_list + [plans[-1].params] * (width - n)
     cols = segment.to_device(
-        device=device, columns=base.needed_columns, packed_codes=True
+        device=device, columns=base.needed_columns, packed_codes=True,
+        residency=residency,
     )
     stacked = {}
     for k, v0 in base.params.items():

@@ -17,6 +17,7 @@ TPU re-design (SURVEY.md section 7 "Segment = pytree of device arrays"):
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -130,6 +131,11 @@ class ImmutableSegment:
         self.valid_docs: Optional[np.ndarray] = None
         self.sort_order: Optional[np.ndarray] = None
         self._device_cache: Dict[str, Any] = {}
+        # guards _device_cache reads/publishes under tiered residency
+        # (segment/residency.py); NEVER held across a device copy — owners
+        # stage with no lock held, then publish in one critical section so a
+        # query racing an eviction re-checks instead of mixing tiers
+        self._device_lock = threading.Lock()
         # durable home of this segment on local disk (set by save/load):
         # the deep store uploads from here without a redundant re-serialize
         self.source_dir: Optional[str] = None
@@ -178,17 +184,109 @@ class ImmutableSegment:
         return list(self.columns)
 
     # -- device residency ----------------------------------------------
+    def device_group(self, device=None):
+        """Residency cache-group key: ALL flavors (raw and #packed) of this
+        segment on one device live and die as a unit."""
+        return ("seg", id(self), device)
+
+    @staticmethod
+    def _entry_bytes(c: ColumnData, use_packed: bool) -> int:
+        """Host-side estimate of the device bytes one cache entry pins."""
+        n = 0
+        if use_packed:
+            n += c.packed.nbytes
+        elif c.codes is not None:
+            n += c.codes.nbytes
+        if c.codes is not None and c.dictionary is not None:
+            dvals = c.dictionary.device_values()
+            if dvals is not None:
+                n += dvals.nbytes
+        for arr in (c.values, c.nulls, c.mv_lengths):
+            if arr is not None:
+                n += arr.nbytes
+        return n
+
+    def _plan_missing(self, device, cols, packed_codes):
+        """(missing [(cname, key, use_packed)], bytes) the cache lacks."""
+        need = []
+        nbytes = 0
+        with self._device_lock:
+            cache = self._device_cache.get(device, {})
+            for cname in cols:
+                c = self.columns[cname]
+                use_packed = bool(packed_codes and c.packed is not None)
+                key = f"{cname}#packed" if use_packed else cname
+                if key in cache:
+                    continue
+                need.append((cname, key, use_packed))
+                nbytes += self._entry_bytes(c, use_packed)
+        return need, nbytes
+
+    def _stage_entry(self, c: ColumnData, use_packed: bool, device) -> Dict[str, Any]:
+        """One column's host->device copy (NO locks held — this runs on the
+        staging stream or a staging owner, never under _device_lock)."""
+        import jax
+
+        entry: Dict[str, Any] = {}
+        if use_packed:
+            entry["codes_packed"] = jax.device_put(np.asarray(c.packed), device)
+        elif c.codes is not None:
+            entry["codes"] = jax.device_put(np.asarray(c.codes), device)
+        if c.codes is not None:
+            dvals = c.dictionary.device_values() if c.dictionary else None
+            if dvals is not None:
+                entry["dict"] = jax.device_put(dvals, device)
+        if c.values is not None:
+            entry["values"] = jax.device_put(np.asarray(c.values), device)
+        if c.nulls is not None:
+            entry["nulls"] = jax.device_put(np.asarray(c.nulls), device)
+        if c.mv_lengths is not None:
+            entry["lengths"] = jax.device_put(np.asarray(c.mv_lengths), device)
+        return entry
+
+    def _assemble(self, device, cols, packed_codes) -> Optional[Dict[str, Any]]:
+        """Read the pytree out of the cache in ONE critical section; None if
+        any needed entry vanished (a racing eviction) — the caller re-stages
+        the whole group, so it can never observe a half-evicted segment."""
+        with self._device_lock:
+            cache = self._device_cache.get(device, {})
+            out: Dict[str, Any] = {}
+            for cname in cols:
+                c = self.columns[cname]
+                use_packed = bool(packed_codes and c.packed is not None)
+                key = f"{cname}#packed" if use_packed else cname
+                if key not in cache:
+                    return None
+                out[cname] = cache[key]
+            return out
+
+    def evict_device(self, device=None) -> None:
+        """Atomic flavor invalidation: the entire per-device cache region —
+        raw, #packed, dict, null entries together — drops in one critical
+        section (residency eviction callback; satellite fix r17)."""
+        with self._device_lock:
+            self._device_cache.pop(device, None)
+
     def to_device(
         self,
         device=None,
         columns: Optional[List[str]] = None,
         packed_codes: bool = False,
+        residency=None,
+        prefetch: bool = False,
+        query_id: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Pin column arrays into device memory; returns the segment pytree.
 
         The pytree is cached — segments are immutable so repeated queries hit
-        HBM-resident arrays (the AcquireReleaseColumnsSegment analog is the
-        residency manager in query/executor.py).
+        HBM-resident arrays.  With `residency` (segment/residency.py) HBM is
+        a byte-budgeted CACHE over the host arrays: staging charges the
+        residency budget (evicting cost-ranked victims to make room), at most
+        one thread copies while the rest park on the group's event, and a
+        mid-stage failure unwinds the charge (crash-harness covered).
+        `prefetch=True` marks the stage as issued ahead of need for the
+        prefetch-hit accounting.  Without `residency` this is the legacy
+        pin-everything path.
 
         packed_codes=True ships bit-packed columns as uint32 lane words under
         entry key "codes_packed" instead of widened "codes" — opt-in because
@@ -196,37 +294,74 @@ class ImmutableSegment:
         the Pallas lane-unpack) can consume it; direct `cols[n]["codes"]`
         readers keep the default.  Packed entries cache under a distinct
         key so the two shapes never alias."""
-        import jax
-
-        cache = self._device_cache.setdefault(device, {})
         cols = columns or list(self.columns)
-        out: Dict[str, Any] = {}
-        for cname in cols:
-            c = self.columns[cname]
-            use_packed = bool(packed_codes and c.packed is not None)
-            key = f"{cname}#packed" if use_packed else cname
-            if key not in cache:
-                entry: Dict[str, Any] = {}
-                if use_packed:
-                    entry["codes_packed"] = jax.device_put(np.asarray(c.packed), device)
-                elif c.codes is not None:
-                    entry["codes"] = jax.device_put(np.asarray(c.codes), device)
-                if c.codes is not None:
-                    dvals = c.dictionary.device_values() if c.dictionary else None
-                    if dvals is not None:
-                        entry["dict"] = jax.device_put(dvals, device)
-                if c.values is not None:
-                    entry["values"] = jax.device_put(np.asarray(c.values), device)
-                if c.nulls is not None:
-                    entry["nulls"] = jax.device_put(np.asarray(c.nulls), device)
-                if c.mv_lengths is not None:
-                    entry["lengths"] = jax.device_put(np.asarray(c.mv_lengths), device)
-                cache[key] = entry
-            out[cname] = cache[key]
-        return out
+        if residency is None:
+            # legacy pin-everything path: no budget, no eviction — but the
+            # copy still happens with no lock held, and the publish races
+            # resolve first-wins through setdefault
+            out: Dict[str, Any] = {}
+            for cname in cols:
+                c = self.columns[cname]
+                use_packed = bool(packed_codes and c.packed is not None)
+                key = f"{cname}#packed" if use_packed else cname
+                with self._device_lock:
+                    entry = self._device_cache.setdefault(device, {}).get(key)
+                if entry is None:
+                    entry = self._stage_entry(c, use_packed, device)
+                    with self._device_lock:
+                        entry = self._device_cache.setdefault(device, {}).setdefault(key, entry)
+                out[cname] = entry
+            return out
+
+        from pinot_tpu.segment import residency as res_mod
+        from pinot_tpu.utils.crashpoints import crash_point
+
+        group = self.device_group(device)
+        while True:
+            missing, _ = self._plan_missing(device, cols, packed_codes)
+            st, entry = residency.begin_stage(
+                group, self.table_name, lambda: self.evict_device(device), prefetch=prefetch
+            )
+            if st == res_mod.WAIT:
+                residency.wait(entry)
+                continue
+            if st == res_mod.HIT:
+                if not missing:
+                    out = self._assemble(device, cols, packed_codes)
+                    if out is not None:
+                        return out
+                    continue  # evicted between plan and read: re-stage
+                # resident but lacking columns/flavors this query needs:
+                # claim the group for incremental staging
+                st2, entry2 = residency.begin_grow(group)
+                if st2 == res_mod.WAIT:
+                    residency.wait(entry2)
+                    continue
+                if st2 == res_mod.RETRY:
+                    continue
+            # OWN: charge, copy (no locks held), publish, commit
+            try:
+                missing, nbytes = self._plan_missing(device, cols, packed_codes)
+                residency.charge(group, nbytes, query_id=query_id)
+                crash_point("segment.stage.after_charge")
+                staged = {
+                    key: self._stage_entry(self.columns[cname], up, device)
+                    for cname, key, up in missing
+                }
+                crash_point("segment.stage.after_copy")
+                with self._device_lock:
+                    self._device_cache.setdefault(device, {}).update(staged)
+            except BaseException:
+                residency.abort_stage(group)
+                raise
+            residency.finish_stage(group)
+            out = self._assemble(device, cols, packed_codes)
+            if out is not None:
+                return out
 
     def release_device(self) -> None:
-        self._device_cache = {}
+        with self._device_lock:
+            self._device_cache.clear()
 
     # -- persistence ----------------------------------------------------
     def save(self, path: str) -> None:

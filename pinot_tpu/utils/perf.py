@@ -412,6 +412,13 @@ GATE_METRICS: Tuple[str, ...] = (
     # streaming bit-packed columns
     "scan_bound_rows_per_sec",
     "agg_bound_rows_per_sec",
+    # tiered-storage working-set sweep (bench.py working_set_sweep): rows/s
+    # with the working set at 1x and 4x the HBM cache budget, plus the
+    # prefetch-hit rate of the staged copy stream on the 4x (capacity-
+    # exceeding) leg — the regime the r11 ledger used to simply 503
+    "ws_sweep_1x_rows_per_sec",
+    "ws_sweep_4x_rows_per_sec",
+    "ws_prefetch_hit_rate",
 )
 
 # Lower-is-better latency series: the gate fails when these RISE past the
@@ -437,6 +444,7 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
     tail = report.get("tail_latency", {}) or {}
     scan_b = report.get("scan_bound", {}) or {}
     agg_b = report.get("agg_bound", {}) or {}
+    ws = report.get("working_set_sweep", {}) or {}
     return {
         "schema": 1,
         "bench": bench,
@@ -461,6 +469,15 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
             "scan_bound_roofline_pct": scan_b.get("roofline_pct"),
             "agg_bound_rows_per_sec": agg_b.get("rows_per_sec"),
             "agg_bound_roofline_pct": agg_b.get("roofline_pct"),
+            "ws_sweep_1x_rows_per_sec": (ws.get("legs", {}).get("1x", {}) or {}).get(
+                "rows_per_sec"
+            ),
+            "ws_sweep_4x_rows_per_sec": (ws.get("legs", {}).get("4x", {}) or {}).get(
+                "rows_per_sec"
+            ),
+            "ws_prefetch_hit_rate": (ws.get("legs", {}).get("4x", {}) or {}).get(
+                "prefetch_hit_rate"
+            ),
         },
         "noise": {"run_variance": report.get("run_variance", 0.0)},
     }

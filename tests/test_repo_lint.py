@@ -900,6 +900,60 @@ class TestW020PackedWidenBeforeUnpack:
         assert _rules(src) == []
 
 
+class TestW021UnbudgetedSegmentDevicePut:
+    def test_flags_bare_device_put_of_segment_codes(self):
+        src = """
+        import jax
+
+        def serve(segment_codes, device):
+            return jax.device_put(segment_codes, device)
+        """
+        assert _rules(src) == ["W021"]
+
+    def test_flags_attribute_operand(self):
+        src = """
+        import jax
+
+        def pin(self, device):
+            return jax.device_put(self.values, device)
+        """
+        assert _rules(src) == ["W021"]
+
+    def test_quiet_inside_staging_scopes(self):
+        src = """
+        import jax
+
+        def to_device(self, device):
+            return jax.device_put(self.codes, device)
+
+        def _stage_entry(plan_packed, device):
+            return jax.device_put(plan_packed, device)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_small_per_query_params(self):
+        src = """
+        import jax
+
+        def dispatch(v, params, device):
+            a = jax.device_put(v, device)
+            b = jax.device_put(params, device)
+            return a, b
+        """
+        assert _rules(src) == []
+
+    def test_nested_non_staging_helper_is_not_exempt(self):
+        src = """
+        import jax
+
+        def to_device(self, device):
+            def pin_all(column_arrays):
+                return jax.device_put(column_arrays, device)
+            return pin_all(self.columns)
+        """
+        assert _rules(src) == ["W021"]
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

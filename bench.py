@@ -555,6 +555,160 @@ def _working_set_sweep() -> dict:
     }
 
 
+def _failover_bench() -> dict:
+    """Coordinator HA failover drill (round-18 tentpole).
+
+    One meta_dir, a leader and a hot standby sharing a SIMULATED clock
+    (lease TTL 2s), brokers behind a CoordinatorHandle whose sleep hook
+    advances that clock — the whole failover runs in virtual time, so the
+    blackout figure measures the protocol (lease expiry + standby
+    replay-to-tip + handle adoption), not host scheduling noise:
+
+      1. FaultPlan.pause_leader freezes the leader (no lease renews, the
+         control plane refuses with NotLeaderError, the data plane keeps
+         serving the last versioned view)
+      2. one control-plane write fires through the handle; every park
+         backoff advances the sim clock AND issues one data-plane query
+         through the broker (the concurrent load), until the standby's
+         election tick sees the expired lease and promotes
+      3. the resumed old leader's next journaled write must FENCE
+         (FencedEpochError) — split-brain cannot reach the journal
+
+    Reports control-plane blackout ms (sim delta from pause to the write
+    landing on the new leader), data-plane success rate during the
+    blackout, and the standby's replay-to-tip ms; `failover_blackout_ms`
+    joins GATE_METRICS_LOWER in the bench-history gate."""
+    import tempfile
+
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.coordinator import Coordinator
+    from pinot_tpu.cluster.election import CoordinatorHandle, FencedEpochError
+    from pinot_tpu.cluster.faults import FaultPlan
+    from pinot_tpu.cluster.server import ServerInstance
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.spi.config import SegmentsConfig, TableConfig
+    from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+    from pinot_tpu.utils.metrics import METRICS
+
+    tmp = tempfile.mkdtemp(prefix="pinot-failover-")
+    sim = [0.0]
+
+    def clock() -> float:
+        return sim[0]
+
+    ttl_s = 2.0
+    leader = Coordinator(
+        replication=2,
+        meta_dir=os.path.join(tmp, "meta"),
+        deep_store=os.path.join(tmp, "deep"),
+        node_id="coord-a",
+        lease_ttl_s=ttl_s,
+        clock=clock,
+    )
+    plan = FaultPlan(seed=7).attach_coordinator(leader)
+
+    probes = {"ok": 0, "bad": 0}
+    in_blackout = [False]
+    sql = "SELECT city, COUNT(*), SUM(v) FROM t GROUP BY city ORDER BY city"
+    expected = []  # filled after warm-up
+
+    def sim_sleep(s: float) -> None:
+        sim[0] += s
+        if in_blackout[0]:
+            # the concurrent query load: one data-plane probe per park
+            # backoff, served off the last routing view while leaderless
+            try:
+                r = broker.query(sql)
+                probes["ok" if list(r.rows) == expected else "bad"] += 1
+            except Exception:  # noqa: BLE001 — a refused probe is the datum
+                probes["bad"] += 1
+
+    handle = CoordinatorHandle([leader], sleep=sim_sleep, clock=clock)
+    schema = Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+    for i in range(2):
+        handle.register_server(
+            ServerInstance(f"server{i}", data_dir=os.path.join(tmp, f"server{i}"))
+        )
+    handle.add_table(schema, TableConfig(name="t", segments=SegmentsConfig(time_column="ts")))
+    rng = np.random.default_rng(19)
+    rows = int(os.environ.get("BENCH_FAILOVER_ROWS", 2_000))
+    for i in range(4):
+        handle.add_segment(
+            "t",
+            build_segment(
+                schema,
+                {
+                    "city": rng.choice(["sf", "nyc", "la"], rows).astype(object),
+                    "v": rng.integers(0, 100, rows),
+                    "ts": 1_700_000_000_000
+                    + rng.integers(0, 86_400_000, rows).astype(np.int64),
+                },
+                f"seg{i}",
+                output_dir=os.path.join(tmp, "build", f"seg{i}"),
+            ),
+        )
+
+    # hot standby boots AFTER the load so bootstrap + incremental tail both run
+    standby = Coordinator(
+        replication=2,
+        meta_dir=os.path.join(tmp, "meta"),
+        deep_store=os.path.join(tmp, "deep"),
+        node_id="coord-b",
+        standby=True,
+        lease_ttl_s=ttl_s,
+        clock=clock,
+    )
+    plan.attach_coordinator(standby)
+    handle.add_candidate(standby)
+
+    broker = Broker(handle)
+    warm = broker.query(sql)
+    expected.extend(list(warm.rows))
+    old_epoch = leader.election.epoch
+
+    # ---- the drill ----------------------------------------------------
+    f0 = METRICS.counter("coordinator.fencedAppends").value
+    plan.pause_leader("coord-a")
+    t0 = sim[0]
+    in_blackout[0] = True
+    handle.heartbeat("server0")  # parks, ticks the election, lands on coord-b
+    in_blackout[0] = False
+    blackout_ms = (sim[0] - t0) * 1000.0
+
+    # ---- split-brain fence proof --------------------------------------
+    plan.resume_leader("coord-a")
+    fenced = False
+    try:
+        leader.drop_table("t")  # old epoch writing directly: must fence
+    except FencedEpochError:
+        fenced = True
+    post = broker.query(sql)  # routed via the adopted new leader's view
+    n_probes = probes["ok"] + probes["bad"]
+    return {
+        "lease_ttl_s": ttl_s,
+        "blackout_ms": round(blackout_ms, 3),
+        "replay_to_tip_ms": round(standby.last_promote_ms, 3),
+        "data_plane": {
+            "queries_during_blackout": n_probes,
+            "ok": probes["ok"],
+            "success_rate": round(probes["ok"] / n_probes, 3) if n_probes else None,
+        },
+        "old_epoch": old_epoch,
+        "new_epoch": standby.election.epoch,
+        "new_leader": standby.node_id,
+        "old_leader_fenced": fenced,
+        "fenced_appends": METRICS.counter("coordinator.fencedAppends").value - f0,
+        "post_failover_query_ok": list(post.rows) == expected,
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -940,6 +1094,7 @@ def main() -> None:
         "tail_latency": _tail_latency_bench(),
         "concurrent_qps": _concurrent_qps_bench(),
         "working_set_sweep": _working_set_sweep(),
+        "failover": _failover_bench(),
     }
     print(json.dumps(report))
 

@@ -142,6 +142,18 @@ accounting AND stalls the caller for the full PCIe transfer instead of
 riding the overlapped copy stream.  Small per-query params (literals,
 bitmap words, stacked scalar pytrees) are fine: the rule keys on the
 operand's name, not the call site.
+
+W022 guards the leadership clock discipline (cluster/election.py): any
+wall-clock `time.time()` arithmetic (+/-/compare, directly or through a
+local alias) inside lease/election/fencing code — a function or class whose
+name mentions lease/election/fence/promote/demote — or anywhere when the
+same expression mixes `time.time()` with a lease/epoch-named identifier.
+Lease deadlines and epoch-fence decisions MUST ride the injectable
+(monotonic-backed) clock: an NTP step on the wall clock would depose a
+healthy leader or immortalize a dead one, and no test can ever drive the
+failover deterministically.  Sharper than W005: W005 only flags elapsed
+subtraction/comparison, while a lease bug's signature is the ADDITION
+(`deadline = time.time() + ttl`), which W005 deliberately ignores.
 """
 from __future__ import annotations
 
@@ -167,6 +179,7 @@ RULES: Dict[str, str] = {
     "W019": "retry/hedge loop re-issues a server call without bounded backoff or without the cancel-probe path",
     "W020": "packed words widened via .astype() in a Pallas kernel body before the lane unpack (shift first, then cast)",
     "W021": "synchronous jax.device_put of a segment-sized array outside the staging stream (route through the residency manager's budgeted charge)",
+    "W022": "wall-clock time.time() arithmetic in lease/election/fencing code (use the injectable/monotonic clock)",
     # interprocedural passes (analysis/races.py, analysis/device_sync.py —
     # run via analysis/engine.py over the whole package, not per-file):
     "W010": "lock-guarded attribute read/written without holding its lock",
@@ -607,6 +620,97 @@ def _check_w005(path: str, tree: ast.AST, findings: List[Finding]) -> None:
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             scan_scope(node.body)
+
+
+# lease/election/fencing scope: the code whose clock MUST be injectable
+_W022_SCOPE = re.compile(r"lease|election|fence|fencing|promote|demote|deposed", re.I)
+# identifiers whose arithmetic against the wall clock marks a fencing bug
+# even outside a scope-named function (max_epoch, lease_deadline, expiresAt)
+_W022_IDENT = re.compile(r"lease|expires|(^|_)epoch", re.I)
+
+
+def _check_w022(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """W022: wall-clock time.time() arithmetic in lease-deadline or
+    epoch-compare code paths.  Two triggers:
+
+      * any +/-/compare involving time.time() (or an exact local alias)
+        inside a function or class whose name matches lease/election/
+        fence/promote/demote — that code's clock must be the injectable
+        one, full stop;
+      * anywhere else, a +/-/compare that MIXES time.time() with a
+        lease/epoch-named identifier (``entry_epoch > time.time() - ttl``).
+
+    Epoch *timestamp* stamping (``int(time.time() * 1000)``) is
+    multiplication, not flagged; retention math over data timestamps never
+    touches time.time() in the same expression and stays clean."""
+
+    def scope_nodes(body: List[ast.stmt]):
+        stack: List[ast.AST] = list(body)
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # nested scope: gets its own pass
+            stack.extend(ast.iter_child_nodes(n))
+
+    def names_match(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and _W022_IDENT.search(n.id):
+                return True
+            if isinstance(n, ast.Attribute) and _W022_IDENT.search(n.attr):
+                return True
+        return False
+
+    def scan(body: List[ast.stmt], scoped: bool) -> None:
+        nodes = list(scope_nodes(body))
+        aliases: Set[str] = set()
+        for n in nodes:
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and _is_time_time_call(n.value)
+            ):
+                aliases.add(n.targets[0].id)
+        for n in nodes:
+            if isinstance(n, ast.BinOp) and isinstance(n.op, (ast.Add, ast.Sub)):
+                operands = [n.left, n.right]
+            elif isinstance(n, ast.Compare):
+                operands = [n.left] + list(n.comparators)
+            else:
+                continue
+            if not any(_contains_time_time(op, aliases) for op in operands):
+                continue
+            if scoped:
+                findings.append(
+                    Finding(
+                        path, n.lineno, "W022",
+                        "wall-clock time.time() arithmetic in lease/election code — "
+                        "use the injectable clock (LeaseManager.now / time.monotonic)",
+                    )
+                )
+            elif any(names_match(op) for op in operands):
+                findings.append(
+                    Finding(
+                        path, n.lineno, "W022",
+                        "time.time() mixed with a lease/epoch identifier — fencing "
+                        "decisions must ride the injectable/monotonic clock",
+                    )
+                )
+
+    def collect(node: ast.AST, enclosing_scoped: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                collect(child, enclosing_scoped or bool(_W022_SCOPE.search(child.name)))
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scoped = enclosing_scoped or bool(_W022_SCOPE.search(child.name))
+                scan(child.body, scoped)
+                collect(child, scoped)
+            else:
+                collect(child, enclosing_scoped)
+
+    scan(getattr(tree, "body", []), False)
+    collect(tree, False)
 
 
 def _check_w006(path: str, tree: ast.AST, findings: List[Finding]) -> None:
@@ -1343,6 +1447,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
     _check_w016(path, tree, findings)
     _check_w017(path, tree, findings)
     _check_w021(path, tree, findings)
+    _check_w022(path, tree, findings)
     if threaded:
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)

@@ -25,6 +25,13 @@ with CRC-verified download).  rebalance.py moves segments under query load
 with load-before-drop ordering, and faults.py + utils/crashpoints.py form
 the deterministic crash harness (scripted server crash/restart, named
 kill-points inside every commit protocol).
+
+Availability (r18): election.py makes the durable control plane HIGHLY
+AVAILABLE — a lease file in meta_dir elects the leader, every journal
+append carries its epoch (the fencing token the journal validates under
+its lock), a hot standby tails the journal and promotes on lease expiry,
+and brokers hold a CoordinatorHandle that rides NotLeaderError across the
+failover while the data plane keeps serving the last versioned view.
 """
 from pinot_tpu.cluster.admission import (
     AdmissionController,
@@ -47,6 +54,13 @@ from pinot_tpu.cluster.broker import (
     ServerHealth,
 )
 from pinot_tpu.cluster.deepstore import SegmentDeepStore
+from pinot_tpu.cluster.election import (
+    CoordinatorHandle,
+    FencedEpochError,
+    JournalFollower,
+    LeaseManager,
+    NotLeaderError,
+)
 from pinot_tpu.cluster.faults import FaultPlan, ServerFaultError
 from pinot_tpu.cluster.journal import MetaJournal
 from pinot_tpu.cluster.rebalance import TableRebalancer
@@ -61,6 +75,11 @@ __all__ = [
     "FaultPlan",
     "ServerFaultError",
     "InjectedCrash",
+    "CoordinatorHandle",
+    "FencedEpochError",
+    "JournalFollower",
+    "LeaseManager",
+    "NotLeaderError",
     "MetaJournal",
     "SegmentDeepStore",
     "TableRebalancer",

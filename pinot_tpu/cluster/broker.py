@@ -30,7 +30,6 @@ from pinot_tpu.cluster.admission import (
     ResourceGovernor,
     estimate_query_cost,
 )
-from pinot_tpu.cluster.coordinator import Coordinator
 from pinot_tpu.query import reduce as reduce_mod
 from pinot_tpu.query.ir import FilterNode, FilterOp, PredicateType, QueryContext
 from pinot_tpu.query.result import ExecutionStats, ResultTable
@@ -467,8 +466,16 @@ def _has_subquery(node: Optional[FilterNode]) -> bool:
 
 
 class Broker:
-    def __init__(self, coordinator: Coordinator, selector: str = "balanced"):
-        self.coordinator = coordinator
+    def __init__(self, coordinator, selector: str = "balanced"):
+        # coordinator HA (r18): the broker never holds a raw Coordinator —
+        # everything routes through a CoordinatorHandle that re-resolves
+        # leadership on NotLeaderError and keeps data-plane reads serving
+        # off the last versioned routing view during a failover.  wrap() is
+        # idempotent, so callers may pass a Coordinator OR a handle over a
+        # leader + standbys.
+        from pinot_tpu.cluster.election import CoordinatorHandle
+
+        self.coordinator = CoordinatorHandle.wrap(coordinator)
         self.selector = selector  # "balanced" | "replicagroup" | "adaptive"
         self._rr = 0  # round-robin cursor
         self._rr_lock = threading.Lock()  # cursor bump is an RMW across handler threads
@@ -525,7 +532,10 @@ class Broker:
         self.batch_clock = None
         self._query_batcher = None
         self._batcher_lock = threading.Lock()
-        coordinator.on_live_change(self._on_live_change)
+        # subscribe via the handle so the subscription is RECORDED and
+        # re-registered on every newly adopted leader (breaker heal keeps
+        # working across a failover)
+        self.coordinator.on_live_change(self._on_live_change)
 
     @staticmethod
     def _result_cache_enabled(ctx: QueryContext) -> bool:
@@ -558,6 +568,11 @@ class Broker:
         breaker (a new Helix session is not the old flaky process)."""
         if up:
             self.health.reset(name)
+
+    def election_snapshot(self) -> Dict:
+        """Leadership view for GET /debug/election: current leader plus
+        per-candidate lease/epoch/role state."""
+        return self.coordinator.election_snapshot()
 
     # -- routing table (built per query from the external view) -----------
     def _route(

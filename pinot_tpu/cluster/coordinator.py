@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from pinot_tpu.cluster.election import FencedEpochError, NotLeaderError
 from pinot_tpu.segment.segment import ImmutableSegment
 from pinot_tpu.spi.config import TableConfig
 from pinot_tpu.spi.schema import Schema
@@ -92,12 +93,27 @@ class Coordinator:
         replication: int = 1,
         meta_dir: Optional[str] = None,
         deep_store=None,
+        node_id: Optional[str] = None,
+        standby: bool = False,
+        lease_ttl_s: Optional[float] = None,
+        clock=None,
     ):
         """`meta_dir` enables the durable control plane: mutations journal
         to {meta_dir}/journal.jsonl (+ compacted snapshots) and a fresh
         Coordinator over the same directory restores identical state.
         `deep_store` (a SegmentDeepStore or root path) is the durable
-        segment home servers re-download from after a crash."""
+        segment home servers re-download from after a crash.
+
+        Coordinator HA (round 18): a durable `meta_dir` also carries the
+        leadership lease (cluster/election.py).  A non-standby boot FORCE
+        acquires it — the operator restarting a coordinator over its own
+        directory takes over, and the epoch bump fences any zombie of the
+        previous process.  `standby=True` boots a HOT STANDBY instead: it
+        tails the leader's journal incrementally (never writing the
+        directory) and `promote()` — or `run_election_tick()` once the
+        lease expires — makes it the fenced leader.  `clock`/`lease_ttl_s`
+        parameterize the lease for tests and the bench (injectable clock;
+        wall-clock lease math is a W022 lint error)."""
         self.replication = replication
         self.tables: Dict[str, TableMeta] = {}
         self.servers: Dict[str, "ServerInstance"] = {}  # noqa: F821
@@ -129,24 +145,60 @@ class Coordinator:
             deep_store = SegmentDeepStore(str(deep_store))
         self.deep_store = deep_store
         self.journal = None
+        self.node_id = node_id or "coordinator"
+        # a coordinator without a durable control plane is trivially the
+        # leader of its single-process cluster
+        self.role = "leader"
+        self.election = None
+        self._follower = None
+        self._paused = False  # sim harness: a GC-frozen process serves nothing
+        self.fault_plan = None  # set by FaultPlan.attach_coordinator
+        if standby and meta_dir is None:
+            raise ValueError("a standby coordinator requires meta_dir (it tails the leader's journal)")
         if meta_dir is not None:
+            from pinot_tpu.cluster.election import JournalFollower, LeaseManager
             from pinot_tpu.cluster.journal import MetaJournal
 
-            self.journal = MetaJournal(meta_dir)
-            if not self._restore():
-                # fresh journal: pin the cluster-wide invariants so a
-                # restored coordinator doesn't fall back to ctor defaults
-                self._journal(
-                    "init",
-                    replication=self.replication,
-                    numReplicaGroups=self.num_replica_groups,
-                )
+            self.election = LeaseManager(
+                meta_dir, self.node_id, ttl_s=lease_ttl_s, clock=clock
+            )
+            if standby:
+                self.role = "standby"
+                self._follower = JournalFollower(meta_dir)
+                state = self._follower.bootstrap()
+                if state:
+                    self._apply_state(state)
+                self.catch_up()
+            else:
+                # boot-time takeover: sweep crash leftovers (a stale
+                # lease.json.tmp must never look like a live lease), then
+                # force-acquire — the epoch bump fences any zombie writer
+                self.election.sweep_stale_tmp()
+                self.election.try_acquire(force=True)
+                self.journal = MetaJournal(meta_dir)
+                self.journal.fence = self.election
+                if not self._restore():
+                    # fresh journal: pin the cluster-wide invariants so a
+                    # restored coordinator doesn't fall back to ctor defaults
+                    self._journal(
+                        "init",
+                        replication=self.replication,
+                        numReplicaGroups=self.num_replica_groups,
+                    )
 
     # -- durable control plane -------------------------------------------
     def _journal(self, op: str, **data: Any) -> None:
         if self.journal is None:
             return
-        self.journal.append(op, **data)
+        try:
+            self.journal.append(op, **data)
+        except FencedEpochError:
+            # the epoch fence tripped: leadership moved past us while we
+            # thought we held it.  A deposed leader CANNOT commit — demote
+            # to standby (the handle re-resolves) and surface the
+            # structured error to the caller's retry path
+            self._demote(release_lease=False)
+            raise
         if self.journal.should_compact():
             self.journal.snapshot(self._state_dict())
 
@@ -193,9 +245,10 @@ class Coordinator:
     def _apply_state(self, state: Dict[str, Any]) -> None:
         self.replication = int(state.get("replication", self.replication))
         self.num_replica_groups = int(state.get("numReplicaGroups", self.num_replica_groups))
-        self.replica_group = {
-            str(k): int(v) for k, v in (state.get("replicaGroup") or {}).items()
-        }
+        with self._membership_lock:
+            self.replica_group = {
+                str(k): int(v) for k, v in (state.get("replicaGroup") or {}).items()
+            }
         for name, t in (state.get("tables") or {}).items():
             meta = TableMeta(
                 schema=Schema.from_dict(t["schema"]),
@@ -248,7 +301,8 @@ class Coordinator:
                 meta.ideal.pop(entry["segment"], None)
                 meta.segment_meta.pop(entry["segment"], None)
         elif op == "register_server":
-            self.replica_group[entry["server"]] = int(entry["group"])
+            with self._membership_lock:
+                self.replica_group[entry["server"]] = int(entry["group"])
         elif op == "rt_checkpoint":
             self.rt_checkpoints.setdefault(entry["table"], {})[int(entry["partition"])] = {
                 "offset": int(entry["offset"]),
@@ -261,6 +315,195 @@ class Coordinator:
         """Force a compacted snapshot now (periodic-task / shutdown hook)."""
         if self.journal is not None:
             self.journal.snapshot(self._state_dict())
+
+    # -- leadership (lease-based election, cluster/election.py) -----------
+    def _require_leader(self) -> None:
+        """Gate on every control-plane mutation: standbys (and paused
+        processes) refuse with the structured error CoordinatorHandle
+        retries on.  This is the cheap in-memory check — the EPOCH FENCE in
+        the journal is the authority for durable writes (a stale leader's
+        non-journaled op may briefly succeed here, exactly like the
+        reference's external-view lag; anything durable cannot)."""
+        if self._paused:
+            raise NotLeaderError(f"coordinator {self.node_id} is paused (frozen process)")
+        if self.role != "leader":
+            raise NotLeaderError(
+                f"coordinator {self.node_id} is a standby (control-plane "
+                "writes go to the leader)",
+            )
+
+    def pause(self) -> None:
+        """Simulation harness: freeze this process (GC pause / VM stall).
+        Every control-plane entry point refuses while paused; lease
+        renewals silently stop (the FaultPlan leader_pause rule drives
+        this).  Data-plane reads stay up — brokers ride the last versioned
+        routing view, which this object still holds."""
+        self._paused = True
+
+    def resume(self) -> None:
+        """Unfreeze.  The process still believes it leads (role unchanged);
+        if the lease moved on while frozen, its next journal append trips
+        the epoch fence and demotes it — the split-brain proof."""
+        self._paused = False
+
+    def catch_up(self) -> int:
+        """Standby: apply newly committed journal entries (incremental tail
+        over the shared TailFollower).  Returns entries applied."""
+        if self._follower is None:
+            return 0
+        state, entries = self._follower.poll()
+        if state is not None:
+            # the leader compacted under us: resync from its snapshot
+            self._reset_state()
+            self._apply_state(state)
+        for entry in entries:
+            self._apply_entry(entry)
+        if state is not None or entries:
+            self._bump_version()
+            from pinot_tpu.utils.metrics import METRICS
+
+            METRICS.counter("coordinator.standbyEntriesApplied").inc(len(entries))
+        return len(entries)
+
+    def _reset_state(self) -> None:
+        """Drop replayable control-plane state before a snapshot resync
+        (membership/live/listeners survive — they are runtime, not
+        journaled, state)."""
+        self.tables.clear()
+        self._rt_dirs.clear()
+        self.rt_checkpoints.clear()
+        with self._membership_lock:
+            self.replica_group.clear()
+
+    def promote(self, force: bool = False) -> bool:
+        """Standby -> leader: acquire the lease (bumping the epoch — the
+        fencing token every subsequent append carries), replay the journal
+        to tip, attach the fence, serve.  Polite by default: returns False
+        while the current lease is live (set `force` for an operator
+        override).  Idempotent on an already-leading coordinator."""
+        if self.role == "leader":
+            return True
+        if self.election is None or self._follower is None:
+            raise RuntimeError("promote() needs a durable meta_dir standby")
+        from pinot_tpu.cluster.journal import MetaJournal
+        from pinot_tpu.utils.metrics import METRICS
+
+        t0 = time.perf_counter()
+        self.catch_up()  # drain what the old leader committed
+        if not self.election.try_acquire(force=force):
+            return False
+        crash_point("election.promote.after_acquire")
+        # now the directory is OURS: sweep crash leftovers and drain
+        # anything that fsync'd between the first drain and the acquisition
+        self.election.sweep_stale_tmp()
+        self.catch_up()
+        # become the journal's writer: adopt the committed seq (load also
+        # truncates a torn tail so our appends start on a clean line)
+        journal = MetaJournal(self.election.meta_dir)
+        journal.fence = self.election
+        journal.fault_plan = self.fault_plan
+        _state, _entries = journal.load()
+        if self._follower.last_seq != journal.seq:
+            # the incremental tail diverged from an authoritative load
+            # (quarantined corruption it skipped past): full resync
+            METRICS.counter("coordinator.promoteResyncs").inc()
+            log.warning(
+                "standby %s tail (seq %d) != journal tip (seq %d); full replay",
+                self.node_id, self._follower.last_seq, journal.seq,
+            )
+            self._reset_state()
+            if _state:
+                self._apply_state(_state)
+            for entry in _entries:
+                self._apply_entry(entry)
+        self.journal = journal
+        self._follower = None
+        self.role = "leader"
+        self._bump_version()
+        self.last_promote_ms = (time.perf_counter() - t0) * 1000.0
+        METRICS.counter("coordinator.failovers").inc()
+        METRICS.gauge("coordinator.isLeader").set(1)
+        log.warning(
+            "coordinator %s promoted to leader at epoch %d (replay-to-tip %.1f ms)",
+            self.node_id, self.election.epoch, self.last_promote_ms,
+        )
+        return True
+
+    def _demote(self, release_lease: bool) -> None:
+        """Leader -> standby.  `release_lease` distinguishes a voluntary
+        step-down (expire the lease now so a standby takes over instantly)
+        from being DEPOSED (the lease belongs to the new leader — touching
+        it would be exactly the zombie write the fence exists to stop)."""
+        from pinot_tpu.cluster.election import JournalFollower
+        from pinot_tpu.utils.metrics import METRICS
+
+        if self.role != "leader" or self.election is None:
+            return
+        seq = 0
+        if self.journal is not None:
+            seq = self.journal.seq
+            self.journal.close()
+            self.journal = None
+        if release_lease:
+            self.election.release()
+        else:
+            self.election.is_leader = False
+        follower = JournalFollower(self.election.meta_dir)
+        # our in-memory state matches the committed prefix (journal-before-
+        # apply, and the fence refuses before any byte lands): tail from it
+        follower.last_seq = seq
+        follower.max_epoch = self.election.epoch
+        self._follower = follower
+        self.role = "standby"
+        METRICS.gauge("coordinator.isLeader").set(0)
+        log.warning("coordinator %s demoted to standby (epoch %d)", self.node_id, self.election.epoch)
+
+    def demote(self) -> None:
+        """Voluntary step-down (operator drain): release the lease so a
+        standby can take over without waiting out the TTL."""
+        self._demote(release_lease=True)
+
+    def run_election_tick(self) -> str:
+        """One deterministic step of the leadership watch loop (tests, the
+        bench, and CoordinatorHandle's failover park drive this; a real
+        deployment would run it on a timer thread): leaders renew their
+        lease (demoting when deposed), standbys tail the journal and take
+        over an expired lease.  Returns the role after the tick."""
+        if self.election is None or self._paused:
+            return self.role
+        if self.role == "leader":
+            if not self.election.renew():
+                self._demote(release_lease=False)
+        else:
+            self.catch_up()
+            cur = self.election.read()
+            # take over an expired lease — or finish our OWN half-done
+            # acquisition (a crash between lease acquire and journal
+            # adoption leaves the lease held but the role standby)
+            if self.election.expired() or (
+                cur is not None and cur.holder == self.election.node_id
+            ):
+                self.promote()
+        return self.role
+
+    def election_state(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"node": self.node_id, "role": self.role, "paused": self._paused}
+        if self.election is not None:
+            out.update(self.election.snapshot())
+        journal = self.journal
+        if journal is not None:
+            out["journalSeq"] = journal.seq
+        elif self._follower is not None:
+            out["journalSeq"] = self._follower.last_seq
+        return out
+
+    def election_snapshot(self) -> Dict[str, Any]:
+        """Single-coordinator form of CoordinatorHandle.election_snapshot
+        (REST /debug/election works against either)."""
+        return {
+            "leader": self.node_id if self.role == "leader" else None,
+            "candidates": [self.election_state()],
+        }
 
     def on_live_change(self, fn) -> None:
         self._live_listeners.append(fn)
@@ -277,6 +520,7 @@ class Coordinator:
 
     # -- instance lifecycle (Helix participant analog) -------------------
     def register_server(self, server) -> None:
+        self._require_leader()
         # attach the per-server HBM reservation ledger (admission tentpole):
         # scatter calls reserve their working-set estimate against it before
         # launching, so concurrent queries can't jointly overcommit HBM.
@@ -370,6 +614,7 @@ class Coordinator:
     def mark_down(self, name: str) -> None:
         """Liveness loss (Helix session expiry analog): external view drops
         the server; ideal state keeps it until rebalance repairs."""
+        self._require_leader()
         with self._membership_lock:
             was_live = name in self.live
             self.live.discard(name)
@@ -381,6 +626,7 @@ class Coordinator:
             self._notify_live(name, up=False)
 
     def mark_up(self, name: str) -> None:
+        self._require_leader()
         with self._membership_lock:
             recovered = name in self.servers and name not in self.live
             if recovered:
@@ -413,6 +659,7 @@ class Coordinator:
 
     # -- table CRUD ------------------------------------------------------
     def add_table(self, schema: Schema, config: Optional[TableConfig] = None) -> None:
+        self._require_leader()
         cfg = config or TableConfig(name=schema.name)
         if cfg.name in self.tables:
             raise ValueError(f"table {cfg.name} already exists")
@@ -427,6 +674,7 @@ class Coordinator:
         the broker serves sealed + consuming segments from it."""
         from pinot_tpu.realtime import RealtimeTableDataManager
 
+        self._require_leader()
         if config.name in self.tables:
             raise ValueError(f"table {config.name} already exists")
         self._journal(
@@ -491,6 +739,7 @@ class Coordinator:
         return total
 
     def drop_table(self, name: str) -> None:
+        self._require_leader()
         self._journal("drop_table", table=name)
         meta = self.tables.pop(name)
         self.realtime.pop(name, None)
@@ -511,6 +760,7 @@ class Coordinator:
         then the assignment journals, then servers load — a crash at any
         point leaves metadata that only ever references durable data, and
         restart reconciliation completes the placement."""
+        self._require_leader()
         meta = self.tables[table]
         targets = self._assign(meta, segment.name)
         if self.deep_store is not None:
@@ -625,6 +875,7 @@ class Coordinator:
         floor, each move committed to the journal before old copies drop)."""
         from pinot_tpu.cluster.rebalance import TableRebalancer
 
+        self._require_leader()
         return TableRebalancer(self).rebalance(
             table, min_available_replicas=min_available_replicas
         )
@@ -647,6 +898,7 @@ class Coordinator:
     def run_retention(self, now_ms: Optional[int] = None) -> List[str]:
         """RetentionManager: drop segments whose time range fell out of the
         retention window."""
+        self._require_leader()
         now_ms = now_ms or int(time.time() * 1000)
         with self._membership_lock:
             servers = dict(self.servers)
@@ -675,6 +927,7 @@ class Coordinator:
         down (the failure-DETECTION half of SURVEY §5.3 — rebalance is the
         recovery half).  Staleness is measured on the monotonic clock: an
         NTP step on the wall clock must never mass-expire the fleet."""
+        self._require_leader()
         if not hasattr(self, "_heartbeats"):
             self._heartbeats: Dict[str, float] = {}
         self._heartbeats[server_name] = time.monotonic()
@@ -702,6 +955,7 @@ class Coordinator:
         (ControllerPeriodicTask analog): liveness check, retention purge,
         realtime consumption step, auto-rebalance of tables with
         under-replicated segments, status report."""
+        self._require_leader()
         dropped = self.check_liveness(heartbeat_timeout_s)
         purged = self.run_retention()
         consumed = self.run_realtime_consumption(max_batches=4)

@@ -26,6 +26,14 @@ src->dst calls one-way while dst->src keeps working.  All delays go through
 the injectable `plan.sleep`, so tier-1 tests swap in a fake clock and never
 block.
 
+Round 18 adds the CONTROL-PLANE fault family for coordinator HA
+(cluster/election.py): pause_leader() freezes a coordinator (every
+control-plane entry point refuses, lease renewals silently stop — the GC
+pause that outlives lease expiry), resume_leader() thaws it into the epoch
+fence, lease_clock_skew() offsets one node's view of cluster time, and
+journal_append_latency() delays durable appends (fsync stall).  Hooks live
+in LeaseManager.now/renew and MetaJournal.append via attach_coordinator().
+
 Determinism contract: the same plan (same seed, same builder calls) applied
 to an identically-built cluster produces the same fault sequence, hence the
 same BrokerResponse — asserted by tests/test_fault_tolerance.py.
@@ -88,6 +96,15 @@ class FaultPlan:
         self._coordinator = None
         self._lock = threading.Lock()
         self._kill_points: List[str] = []  # armed via kill_at, for reset
+        # control-plane fault state (coordinator HA): paused leader node
+        # ids, per-node lease clock skew, per-node journal append latency,
+        # and the coordinators wired via attach_coordinator (keyed by
+        # node_id — one entry per cluster coordinator)
+        self._paused_leaders: Set[str] = set()
+        self._lease_skew_ms: Dict[str, float] = {}
+        self._journal_latency_ms: Dict[str, float] = {}
+        self._journal_appends: Dict[str, int] = {}
+        self._coordinators: Dict[str, object] = {}
 
     # -- wiring ----------------------------------------------------------
     def attach(self, coordinator) -> "FaultPlan":
@@ -96,6 +113,21 @@ class FaultPlan:
         self._coordinator = coordinator
         for s in coordinator.servers.values():
             s.fault_plan = self
+        self.attach_coordinator(coordinator)
+        return self
+
+    def attach_coordinator(self, coordinator) -> "FaultPlan":
+        """Wire the control-plane fault hooks (lease skew, renew
+        suppression, journal append latency) into one coordinator — call it
+        for the leader AND each standby; attach() covers the leader."""
+        self._coordinators[getattr(coordinator, "node_id", "coordinator")] = coordinator
+        coordinator.fault_plan = self
+        election = getattr(coordinator, "election", None)
+        if election is not None:
+            election.fault_plan = self
+        journal = getattr(coordinator, "journal", None)
+        if journal is not None:
+            journal.fault_plan = self
         return self
 
     # -- plan builders (chainable) ----------------------------------------
@@ -196,6 +228,72 @@ class FaultPlan:
         # plan builder (test-authored, bounded), not a serving path
         self._rules.append(_Rule("restart", of or server, server, calls={on_call}))  # pinot-lint: disable=W015
         return self
+
+    # -- control-plane rules (coordinator HA) ------------------------------
+    def pause_leader(self, node_id: str) -> "FaultPlan":
+        """Freeze a coordinator process (GC pause / VM stall): every
+        control-plane entry point refuses with NotLeaderError and its lease
+        renewals silently stop — hold it past lease expiry and a standby
+        takes over.  resume_leader() thaws it STILL BELIEVING it leads;
+        its next journal append is what the epoch fence exists to stop."""
+        with self._lock:
+            self._paused_leaders.add(node_id)
+            self.log.append((node_id, 0, "pause_leader", node_id))  # pinot-lint: disable=W015
+        coord = self._coordinators.get(node_id)
+        if coord is not None:
+            coord.pause()
+        return self
+
+    def resume_leader(self, node_id: str) -> "FaultPlan":
+        with self._lock:
+            self._paused_leaders.discard(node_id)
+            self.log.append((node_id, 0, "resume_leader", node_id))  # pinot-lint: disable=W015
+        coord = self._coordinators.get(node_id)
+        if coord is not None:
+            coord.resume()
+        return self
+
+    def lease_clock_skew(self, node_id: str, ms: float) -> "FaultPlan":
+        """Skew one node's view of cluster time by `ms` (positive = its
+        clock runs ahead): a skewed-ahead standby sees the lease expire
+        early and races the takeover — the fence, not the clock, is what
+        keeps the journal single-writer."""
+        with self._lock:
+            self._lease_skew_ms[node_id] = float(ms)
+            self.log.append((node_id, 0, "lease_clock_skew", ms))  # pinot-lint: disable=W015
+        return self
+
+    def journal_append_latency(self, node_id: str, ms: float) -> "FaultPlan":
+        """Stall every durable journal append on `node_id` by `ms` (a slow
+        fsync / contended disk): widens the window between the fence check
+        and the write, which the append-under-lock discipline must keep
+        safe."""
+        with self._lock:
+            self._journal_latency_ms[node_id] = float(ms)
+            self.log.append((node_id, 0, "journal_append_latency", ms))  # pinot-lint: disable=W015
+        return self
+
+    # control-plane hooks (called from LeaseManager / MetaJournal)
+    def allow_lease_renew(self, node_id: str) -> bool:
+        with self._lock:
+            paused = node_id in self._paused_leaders
+            if paused:
+                self.log.append((node_id, 0, "renew_suppressed", node_id))  # pinot-lint: disable=W015
+        return not paused
+
+    def lease_skew_ms(self, node_id: str) -> float:
+        with self._lock:
+            return self._lease_skew_ms.get(node_id, 0.0)
+
+    def on_journal_append(self, node_id: str) -> None:
+        with self._lock:
+            self._journal_appends[node_id] = self._journal_appends.get(node_id, 0) + 1
+            n = self._journal_appends[node_id]
+            ms = self._journal_latency_ms.get(node_id, 0.0)
+            if ms > 0:
+                self.log.append((node_id, n, "journal_append_latency", ms))  # pinot-lint: disable=W015
+        if ms > 0:
+            self.sleep(ms / 1000.0)
 
     def kill_at(self, point: str, hit: int = 1) -> "FaultPlan":
         """Arm a named kill-point (utils/crashpoints.py): the `hit`-th time

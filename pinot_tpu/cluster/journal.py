@@ -17,10 +17,18 @@ handler tolerates by being idempotent (set-valued ideal state, last-writer
 checkpoint pointers).
 
 Recovery tolerates exactly the artifacts crashes produce: a truncated final
-journal line is dropped (it never committed — its fsync didn't return); a
-corrupt snapshot is quarantined aside (`.corrupt-N`) and the previous
-snapshot (`snapshot.json.bak`) or empty state is used; stale `*.tmp` files
-are swept.
+journal line is dropped AND truncated off the file (it never committed — its
+fsync didn't return; cutting the partial bytes means a later append can
+never concatenate onto them into one garbled line); a corrupt snapshot is
+quarantined aside (`.corrupt-N`) and the previous snapshot
+(`snapshot.json.bak`) or empty state is used; stale `*.tmp` files are swept.
+
+Round 18 adds the EPOCH FENCE (cluster/election.py): when a LeaseManager is
+attached as `self.fence`, every append re-validates the durable lease under
+the journal lock and stamps the entry with the writer's epoch; an append
+from a deposed epoch raises FencedEpochError before any byte reaches the
+log (counter `coordinator.fencedAppends`), and replay drops any
+epoch-regressed interleaving a torn race still managed to leave behind.
 """
 from __future__ import annotations
 
@@ -30,6 +38,7 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from pinot_tpu.cluster.election import NotLeaderError
 from pinot_tpu.spi.filesystem import durable_write_json, fsync_dir, sweep_tmp
 from pinot_tpu.utils.crashpoints import crash_point
 from pinot_tpu.utils.metrics import METRICS
@@ -67,6 +76,11 @@ class MetaJournal:
         self._fh = None  # lazily (re)opened append handle
         self.seq = 0  # last durably appended entry seq
         self.appended_since_snapshot = 0
+        # LeaseManager epoch fence (cluster/election.py); None = unfenced
+        # (a coordinator without an election, or legacy callers)
+        self.fence = None
+        # FaultPlan hook for the journal_append_latency rule
+        self.fault_plan = None
 
     # -- paths -----------------------------------------------------------
     @property
@@ -83,12 +97,28 @@ class MetaJournal:
         committed once fsync returns — a crash before that point loses (at
         most) a torn final line, which load() drops."""
         with self._lock:
+            plan = self.fault_plan
+            if plan is not None:
+                plan.on_journal_append(
+                    self.fence.node_id if self.fence is not None else "journal"
+                )
+            epoch = 0
+            if self.fence is not None:
+                try:
+                    epoch = self.fence.validate_writer()
+                except NotLeaderError:
+                    # a deposed writer: refuse BEFORE any byte hits the log
+                    # (seq untouched — the entry never existed)
+                    METRICS.counter("coordinator.fencedAppends").inc()
+                    raise
             self.seq += 1
             # reserved keys win: an op payload must never clobber the
-            # journal's own sequencing fields
+            # journal's own sequencing/fencing fields
             entry = dict(data)
             entry["seq"] = self.seq
             entry["op"] = op
+            if self.fence is not None:
+                entry["epoch"] = epoch
             line = json.dumps(entry, separators=(",", ":")) + "\n"
             if self._fh is None:
                 self._fh = open(self.journal_path, "a", encoding="utf-8")
@@ -169,22 +199,30 @@ class MetaJournal:
         if not os.path.exists(path):
             return []
         entries: List[Dict[str, Any]] = []
-        with open(path, "r", encoding="utf-8") as f:
-            lines = f.read().split("\n")
+        raw_lines: List[Tuple[int, bytes]] = []  # (byte offset, raw line)
+        with open(path, "rb") as f:
+            off = 0
+            for raw in iter(f.readline, b""):
+                raw_lines.append((off, raw))
+                off += len(raw)
         last_seq = after_seq
-        for i, line in enumerate(lines):
-            line = line.strip()
+        max_epoch = 0
+        for i, (off, raw) in enumerate(raw_lines):
+            line = raw.decode("utf-8", errors="replace").strip()
             if not line:
                 continue
             try:
                 entry = json.loads(line)
                 seq = int(entry["seq"])
             except (json.JSONDecodeError, KeyError, TypeError, ValueError):
-                if i >= len(lines) - 2:
+                if i == len(raw_lines) - 1 or not raw.endswith(b"\n"):
                     # torn final line: the append died before fsync — that
-                    # entry never committed, dropping it IS the recovery
+                    # entry never committed.  Drop it AND cut the partial
+                    # bytes off the file, so the next append starts a fresh
+                    # line instead of concatenating into garbage
                     METRICS.counter("coordinator.journalTornTail").inc()
-                    log.warning("dropping torn journal tail line in %s", path)
+                    log.warning("truncating torn journal tail line in %s", path)
+                    self._truncate_at_locked(off)
                     break
                 # mid-file corruption: quarantine the whole log; committed
                 # state up to the snapshot survives
@@ -197,9 +235,32 @@ class MetaJournal:
                 return entries
             if seq <= last_seq:
                 continue  # replay overlap after a crash mid-compaction
+            epoch = int(entry.get("epoch", 0) or 0)
+            if epoch < max_epoch:
+                # interleaving from a deposed epoch (belt to the append
+                # fence's suspenders): replay ignores it
+                METRICS.counter("coordinator.fencedReplayDropped").inc()
+                continue
+            if epoch > max_epoch:
+                max_epoch = epoch
             last_seq = seq
             entries.append(entry)
         return entries
+
+    def _truncate_at_locked(self, offset: int) -> None:
+        """Cut the journal back to `offset` (torn-tail recovery).  Best
+        effort: a failure here just leaves the pre-r18 behavior (the torn
+        line stays on disk and keeps being dropped at every load)."""
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        try:
+            with open(self.journal_path, "r+b") as f:
+                f.truncate(offset)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            log.exception("could not truncate torn journal tail in %s", self.journal_path)
 
     def close(self) -> None:
         with self._lock:

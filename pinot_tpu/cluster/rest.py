@@ -136,6 +136,14 @@ class QueryServer:
                             from pinot_tpu.utils.perf import PERF_LEDGER
 
                             self._send(200, PERF_LEDGER.snapshot())
+                    elif url.path == "/debug/election":
+                        # coordinator HA view: current leader + per-candidate
+                        # lease/epoch/role state (cluster/election.py)
+                        snap_fn = getattr(outer.engine, "election_snapshot", None)
+                        if snap_fn is None:
+                            self._send(404, {"error": "engine has no election view"})
+                            return
+                        self._send(200, snap_fn())
                     elif url.path.startswith("/cursors/"):
                         parts = url.path.strip("/").split("/")
                         cid = parts[1]
@@ -178,9 +186,15 @@ class QueryServer:
                         QuotaExceededError,
                         ScatterGatherError,
                     )
+                    from pinot_tpu.cluster.election import NotLeaderError
                     from pinot_tpu.query.safety import AdmissionError, QueryTimeoutError
 
-                    if isinstance(e, QuotaExceededError):
+                    if isinstance(e, NotLeaderError):
+                        # control-plane leadership moved and the bounded
+                        # failover park expired: retryable 503 — the standby
+                        # finishes taking over and the next attempt serves
+                        self._send(503, {"error": str(e), "errorCode": "NOT_LEADER"})
+                    elif isinstance(e, QuotaExceededError):
                         # the reference's 429 QUERY_QUOTA_EXCEEDED contract:
                         # throttled clients must be able to back off
                         self._send(429, {"error": str(e), "errorCode": "QUERY_QUOTA_EXCEEDED"})

@@ -110,58 +110,37 @@ class _MemoryConsumer(PartitionGroupConsumer):
 class FileStream(PartitionGroupConsumer):
     """JSONL file tail: offset = line number.  The batch-file analog of a
     stream partition (reference: pinot-file-ingestion via stream SPI); lines
-    appended after open are visible to subsequent fetches."""
+    appended after open are visible to subsequent fetches.
+
+    The incremental tail (byte-offset memo + torn-tail park) rides the
+    shared spi.filesystem.TailFollower — the same follower the standby
+    coordinator tails the metadata journal with (cluster/election.py)."""
 
     def __init__(self, path: str):
+        from pinot_tpu.spi.filesystem import TailFollower
+
         self.path = path
-        # incremental-tail memo: byte position of line index _memo_line —
-        # a steady-state consume loop seeks straight to where it left off
-        # instead of re-reading the whole file every fetch (O(total) per
-        # batch made long-running tails quadratic)
-        self._memo_line = 0
-        self._memo_pos = 0
+        self._tail = TailFollower(path)
 
     def fetch(self, start_offset: int, max_messages: int = 1024) -> MessageBatch:
         """Offsets are RAW line indices (blank lines consume an offset but
         emit no message) so fetch/next_offset/latest_offset stay aligned."""
-        msgs: List[StreamMessage] = []
         if not os.path.exists(self.path):
             return MessageBatch(messages=[], next_offset=start_offset, end_of_partition=True)
-        next_offset = start_offset
-        with open(self.path, "rb") as f:
-            if start_offset == self._memo_line and self._memo_pos > 0:
-                # the memo only short-circuits an append-only file: if it
-                # was truncated/rewritten shorter, fall back to a rescan
-                if os.fstat(f.fileno()).st_size >= self._memo_pos:
-                    f.seek(self._memo_pos)
-                    i = self._memo_line
-                else:
-                    i = 0
-            else:
-                i = 0
-            if i == 0 and start_offset != 0:
-                # skip to start_offset the slow way (cold start / replay)
-                while i < start_offset:
-                    if not f.readline():
-                        break
-                    i += 1
-            for raw in iter(f.readline, b""):
-                if not raw.endswith(b"\n"):
-                    # torn tail: a writer crashed (or is) mid-line — leave it
-                    # unconsumed and park the memo BEFORE the partial bytes
-                    # so the next fetch re-reads the completed line
-                    self._memo_line, self._memo_pos = i, f.tell() - len(raw)
-                    return MessageBatch(messages=msgs, next_offset=next_offset, end_of_partition=True)
-                if len(msgs) >= max_messages:
-                    self._memo_line, self._memo_pos = i, f.tell() - len(raw)
-                    return MessageBatch(messages=msgs, next_offset=next_offset, end_of_partition=False)
-                i += 1
-                next_offset = i
-                line = raw.decode("utf-8").strip()
-                if line:
-                    msgs.append(StreamMessage(value=json.loads(line), offset=i))
-            self._memo_line, self._memo_pos = i, f.tell()
-        return MessageBatch(messages=msgs, next_offset=next_offset, end_of_partition=True)
+        lines, next_offset, eof, _truncated = self._tail.read(
+            start_line=start_offset,
+            max_lines=max_messages,
+            count_line=lambda s: bool(s.strip()),
+        )
+        # a consumer's offset never regresses: a start past EOF (or a file
+        # rewritten shorter) reports no progress, not a rewind
+        next_offset = max(next_offset, start_offset)
+        msgs: List[StreamMessage] = []
+        for i, text in lines:
+            text = text.strip()
+            if text:
+                msgs.append(StreamMessage(value=json.loads(text), offset=i))
+        return MessageBatch(messages=msgs, next_offset=next_offset, end_of_partition=eof)
 
     def latest_offset(self) -> int:
         if not os.path.exists(self.path):

@@ -196,6 +196,37 @@ def cmd_admission(args) -> int:
     return 0
 
 
+def cmd_election(args) -> int:
+    """Print a serving endpoint's coordinator-HA view (GET /debug/election):
+    current leader plus per-candidate lease/epoch/role state."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/debug/election"
+    with urllib.request.urlopen(url) as resp:
+        payload = json.loads(resp.read().decode("utf-8"))
+    if args.json:
+        print(json.dumps(payload, indent=2))
+        return 0
+    print(f"leader  : {payload.get('leader') or '(none)'}")
+    for c in payload.get("candidates", []):
+        lease = c.get("lease")
+        if lease is None:
+            held = "no lease on disk"
+        else:
+            held = (
+                f"lease holder={lease.get('holder')} epoch={lease.get('epoch')} "
+                f"expires in {lease.get('expiresIn_s', 0):g} s"
+            )
+        flags = " PAUSED" if c.get("paused") else ""
+        print(
+            f"  {c.get('node')}: role={c.get('role')} epoch={c.get('epoch')} "
+            f"journalSeq={c.get('journalSeq', '-')} ttl={c.get('ttl_s', 0):g}s "
+            f"[{held}]{flags}"
+        )
+    print(f"-- {len(payload.get('candidates', []))} candidate(s)", file=sys.stderr)
+    return 0
+
+
 def cmd_perf(args) -> int:
     """Perf observatory view + bench-regression gate.
 
@@ -351,6 +382,11 @@ def main(argv=None) -> int:
     ad.add_argument("--url", default="http://127.0.0.1:8099", help="query server base URL")
     ad.add_argument("--json", action="store_true", help="dump the raw snapshot as JSON")
     ad.set_defaults(fn=cmd_admission)
+
+    el = sub.add_parser("election", help="print a serving endpoint's coordinator-HA leadership view")
+    el.add_argument("--url", default="http://127.0.0.1:8099", help="query server base URL")
+    el.add_argument("--json", action="store_true", help="dump the raw snapshot as JSON")
+    el.set_defaults(fn=cmd_election)
 
     pf = sub.add_parser("perf", help="perf ledger view + bench-regression gate")
     pf.add_argument("--url", default="http://127.0.0.1:8099", help="query server base URL")

@@ -425,7 +425,10 @@ GATE_METRICS: Tuple[str, ...] = (
 # allowance (drop is computed with the sign flipped).  hedged_p99_ms is the
 # tail_latency bench's hedged p99 under one 10x-degraded replica — the
 # tail-tolerance layer's whole point is keeping it near the fault-free p99.
-GATE_METRICS_LOWER: Tuple[str, ...] = ("hedged_p99_ms",)
+# failover_blackout_ms is the HA drill's control-plane blackout in SIM time
+# (lease expiry + standby replay-to-tip + handle adoption): the election
+# protocol's cost, which a regression in lease/fence/promote code inflates.
+GATE_METRICS_LOWER: Tuple[str, ...] = ("hedged_p99_ms", "failover_blackout_ms")
 
 # Allowance bounds: at least 15% slack (CI-grade CPU runs are noisy even
 # with bench.py's median-of-pairs machinery), never 20%+ — the acceptance
@@ -445,6 +448,7 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
     scan_b = report.get("scan_bound", {}) or {}
     agg_b = report.get("agg_bound", {}) or {}
     ws = report.get("working_set_sweep", {}) or {}
+    fo = report.get("failover", {}) or {}
     return {
         "schema": 1,
         "bench": bench,
@@ -477,6 +481,11 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
             ),
             "ws_prefetch_hit_rate": (ws.get("legs", {}).get("4x", {}) or {}).get(
                 "prefetch_hit_rate"
+            ),
+            "failover_blackout_ms": fo.get("blackout_ms"),
+            "failover_replay_ms": fo.get("replay_to_tip_ms"),
+            "failover_data_plane_success_rate": (fo.get("data_plane", {}) or {}).get(
+                "success_rate"
             ),
         },
         "noise": {"run_variance": report.get("run_variance", 0.0)},

@@ -1,0 +1,712 @@
+"""Coordinator HA (round 18): lease-based leader election, fenced journal
+epochs, hot-standby failover.
+
+The contract under test is Taurus-shaped: the durable journal is the
+database, and availability comes from fencing WHO may write it.  A lease
+file in meta_dir elects the leader and mints a monotonically increasing
+epoch (the fencing token); every journal append carries its writer's epoch
+and the journal refuses appends from a deposed one BEFORE any byte lands.
+A hot standby tails the journal incrementally (the shared TailFollower),
+promotes on lease expiry, and brokers ride a CoordinatorHandle across the
+failover — data-plane queries keep serving off the last versioned routing
+view the whole time.
+
+The split-brain proof: pause the leader past expiry, promote the standby,
+resume the old leader — its next durable write MUST fence, the on-disk
+journal must show no interleaved epochs, and a third coordinator replaying
+the directory must land on the new leader's exact state.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.broker import Broker
+from pinot_tpu.cluster.coordinator import Coordinator
+from pinot_tpu.cluster.election import (
+    CoordinatorHandle,
+    FencedEpochError,
+    JournalFollower,
+    LeaseManager,
+    NotLeaderError,
+)
+from pinot_tpu.cluster.faults import FaultPlan
+from pinot_tpu.cluster.journal import JOURNAL_FILE
+from pinot_tpu.cluster.server import ServerInstance
+from pinot_tpu.segment.builder import build_segment
+from pinot_tpu.spi.config import SegmentsConfig, TableConfig
+from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+from pinot_tpu.utils import crashpoints
+from pinot_tpu.utils.crashpoints import InjectedCrash
+from pinot_tpu.utils.metrics import METRICS
+
+from golden import assert_same_rows
+
+
+@pytest.fixture(autouse=True)
+def _clean_kill_points():
+    crashpoints.reset()
+    yield
+    crashpoints.reset()
+
+
+class SimClock:
+    """Injectable monotonic clock: the whole election runs in virtual time."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> "SimClock":
+        self.t += s
+        return self
+
+
+def _schema():
+    return Schema(
+        "t",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+
+
+def _data(n, seed, t0=1_700_000_000_000):
+    rng = np.random.default_rng(seed)
+    return {
+        "city": rng.choice(["sf", "nyc", "la"], n).astype(object),
+        "v": rng.integers(0, 100, n),
+        "ts": t0 + rng.integers(0, 86_400_000, n).astype(np.int64),
+    }
+
+
+def _fingerprint(coord):
+    """Replayed-state identity: assignment + metadata + membership."""
+    out = {"replication": coord.replication, "groups": dict(coord.replica_group)}
+    for name, meta in sorted(coord.tables.items()):
+        out[name] = {
+            "ideal": {seg: sorted(srvs) for seg, srvs in meta.ideal.items()},
+            "numDocs": {seg: m["numDocs"] for seg, m in meta.segment_meta.items()},
+        }
+    return out
+
+
+TTL = 2.0
+
+
+def _ha_cluster(tmp_path, clock, n_servers=3, replication=2, n_segments=3, rows=150):
+    """Leader coordinator over a durable meta_dir + deep store, on the sim
+    clock, with servers/table/segments loaded.  Standbys join per-test."""
+    leader = Coordinator(
+        replication=replication,
+        meta_dir=str(tmp_path / "meta"),
+        deep_store=str(tmp_path / "deep"),
+        node_id="coord-a",
+        lease_ttl_s=TTL,
+        clock=clock,
+    )
+    servers = [
+        ServerInstance(f"server{i}", data_dir=str(tmp_path / f"server{i}"))
+        for i in range(n_servers)
+    ]
+    for s in servers:
+        leader.register_server(s)
+    leader.add_table(_schema(), TableConfig(name="t", segments=SegmentsConfig(time_column="ts")))
+    for i in range(n_segments):
+        leader.add_segment(
+            "t",
+            build_segment(
+                _schema(), _data(rows, seed=100 + i), f"seg{i}",
+                output_dir=str(tmp_path / "build" / f"seg{i}"),
+            ),
+        )
+    return leader, servers
+
+
+def _standby(tmp_path, clock, node_id="coord-b"):
+    return Coordinator(
+        replication=2,
+        meta_dir=str(tmp_path / "meta"),
+        deep_store=str(tmp_path / "deep"),
+        node_id=node_id,
+        standby=True,
+        lease_ttl_s=TTL,
+        clock=clock,
+    )
+
+
+QUERIES = [
+    "SELECT COUNT(*), SUM(v) FROM t",
+    "SELECT city, COUNT(*), SUM(v) FROM t GROUP BY city ORDER BY city",
+]
+
+
+# ---------------------------------------------------------------------------
+# LeaseManager unit behavior
+# ---------------------------------------------------------------------------
+class TestLeaseManager:
+    def test_acquire_expire_takeover_bumps_epoch(self, tmp_path):
+        clock = SimClock()
+        a = LeaseManager(str(tmp_path), "a", ttl_s=TTL, clock=clock)
+        b = LeaseManager(str(tmp_path), "b", ttl_s=TTL, clock=clock)
+        assert a.try_acquire() and a.epoch == 1 and a.is_leader
+        # polite acquire refuses a live foreign lease
+        assert not b.try_acquire()
+        clock.advance(TTL + 0.1)
+        assert b.try_acquire() and b.epoch == 2
+        # the deposed holder discovers the loss on its next renew
+        assert a.renew() is False and a.is_leader is False
+        assert METRICS.counter("coordinator.leadershipLost").value == 1
+
+    def test_renew_extends_the_deadline(self, tmp_path):
+        clock = SimClock()
+        a = LeaseManager(str(tmp_path), "a", ttl_s=TTL, clock=clock)
+        b = LeaseManager(str(tmp_path), "b", ttl_s=TTL, clock=clock)
+        assert a.try_acquire()
+        clock.advance(TTL * 0.75)
+        assert a.renew() is True
+        clock.advance(TTL * 0.75)  # past the ORIGINAL deadline, not the renewed one
+        assert not b.try_acquire()
+        assert not b.expired()
+
+    def test_corrupt_lease_quarantines_and_election_recovers(self, tmp_path):
+        clock = SimClock()
+        a = LeaseManager(str(tmp_path), "a", ttl_s=TTL, clock=clock)
+        with open(a.lease_path, "w", encoding="utf-8") as f:
+            f.write('{"holder": "a", "epo')  # torn write from a dead kernel
+        assert a.read() is None
+        assert os.path.exists(a.lease_path + ".corrupt-0")
+        assert METRICS.counter("coordinator.leaseCorrupt").value == 1
+        # an unreadable lease must not wedge the election forever
+        assert a.try_acquire() and a.is_leader
+
+    def test_force_acquire_fences_the_previous_holder(self, tmp_path):
+        clock = SimClock()
+        a = LeaseManager(str(tmp_path), "a", ttl_s=TTL, clock=clock)
+        b = LeaseManager(str(tmp_path), "b", ttl_s=TTL, clock=clock)
+        assert a.try_acquire() and a.epoch == 1
+        assert b.try_acquire(force=True) and b.epoch == 2  # boot-time takeover
+        with pytest.raises(FencedEpochError):
+            a.validate_writer()
+        assert a.is_leader is False  # the fence demotes in place
+
+    def test_equal_epoch_foreign_holder_fences_the_race_loser(self, tmp_path):
+        clock = SimClock()
+        a = LeaseManager(str(tmp_path), "a", ttl_s=TTL, clock=clock)
+        b = LeaseManager(str(tmp_path), "b", ttl_s=TTL, clock=clock)
+        assert a.try_acquire()
+        clock.advance(TTL + 0.1)
+        # two racing acquisitions of the expired lease both bump to 2; b's
+        # durable write lands last, so a is the loser whose write vanished
+        assert b.try_acquire() and b.epoch == 2
+        a.epoch, a.is_leader = 2, True
+        with pytest.raises(FencedEpochError):
+            a.validate_writer()
+        assert b.validate_writer() == 2
+
+    def test_release_hands_over_without_waiting_out_the_ttl(self, tmp_path):
+        clock = SimClock()
+        a = LeaseManager(str(tmp_path), "a", ttl_s=TTL, clock=clock)
+        b = LeaseManager(str(tmp_path), "b", ttl_s=TTL, clock=clock)
+        assert a.try_acquire()
+        a.release()
+        assert b.try_acquire() and b.epoch == 2  # polite, zero clock advance
+
+    def test_clock_skew_rule_shifts_one_nodes_view(self, tmp_path):
+        clock = SimClock()
+        a = LeaseManager(str(tmp_path), "a", ttl_s=TTL, clock=clock)
+        b = LeaseManager(str(tmp_path), "b", ttl_s=TTL, clock=clock)
+        plan = FaultPlan().lease_clock_skew("b", (TTL + 1) * 1000.0)
+        b.fault_plan = plan
+        assert a.try_acquire()
+        # b's clock runs TTL+1s ahead: it sees the fresh lease as expired
+        assert b.expired() and not a.expired()
+        assert b.try_acquire() and b.epoch == 2
+        # the fence (not the clock) is what keeps the journal single-writer
+        with pytest.raises(FencedEpochError):
+            a.validate_writer()
+
+
+class TestStaleLeaseTmpSweep:
+    def test_boot_sweeps_stale_lease_tmp(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock, n_segments=1)
+        del leader
+        stale = tmp_path / "meta" / "lease.json.tmp"
+        stale.write_text('{"holder": "ghost", "epoch": 99}')
+        METRICS.reset()
+        Coordinator(
+            meta_dir=str(tmp_path / "meta"), node_id="coord-b",
+            lease_ttl_s=TTL, clock=clock,
+        )
+        assert not stale.exists()
+        assert METRICS.counter("coordinator.staleLeaseTmpSwept").value >= 1
+
+    def test_crash_mid_acquire_leaves_only_a_sweepable_tmp(self, tmp_path):
+        clock = SimClock()
+        a = LeaseManager(str(tmp_path), "a", ttl_s=TTL, clock=clock)
+        crashpoints.arm("election.acquire.after_write")
+        with pytest.raises(InjectedCrash):
+            a.try_acquire()
+        # died between tmp write and rename: no committed lease exists
+        assert not os.path.exists(a.lease_path)
+        assert os.path.exists(a.lease_path + ".tmp")
+        b = LeaseManager(str(tmp_path), "b", ttl_s=TTL, clock=clock)
+        b.sweep_stale_tmp()
+        assert not os.path.exists(a.lease_path + ".tmp")
+        assert METRICS.counter("coordinator.staleLeaseTmpSwept").value == 1
+        assert b.try_acquire() and b.epoch == 1  # nothing was committed
+
+    def test_crash_after_replace_committed_the_lease(self, tmp_path):
+        clock = SimClock()
+        a = LeaseManager(str(tmp_path), "a", ttl_s=TTL, clock=clock)
+        crashpoints.arm("election.acquire.after_replace")
+        with pytest.raises(InjectedCrash):
+            a.try_acquire()
+        b = LeaseManager(str(tmp_path), "b", ttl_s=TTL, clock=clock)
+        cur = b.read()
+        assert cur is not None and cur.holder == "a" and cur.epoch == 1
+        assert not b.try_acquire()  # committed and live: polite refusal
+        clock.advance(TTL + 0.1)
+        assert b.try_acquire() and b.epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# standby tailing (shared TailFollower) + epoch-filtered replay
+# ---------------------------------------------------------------------------
+class TestStandbyTailing:
+    def test_standby_applies_the_leaders_writes_incrementally(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock)
+        standby = _standby(tmp_path, clock)
+        assert standby.role == "standby"
+        assert _fingerprint(standby) == _fingerprint(leader)
+        leader.add_table(
+            Schema("t2", [FieldSpec("x", DataType.LONG, role=FieldRole.METRIC)]),
+            TableConfig(name="t2"),
+        )
+        assert standby.catch_up() >= 1
+        assert "t2" in standby.tables
+        assert _fingerprint(standby) == _fingerprint(leader)
+        assert METRICS.counter("coordinator.standbyEntriesApplied").value >= 1
+
+    def test_standby_resyncs_after_leader_compaction(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock)
+        standby = _standby(tmp_path, clock)
+        leader.add_table(
+            Schema("t2", [FieldSpec("x", DataType.LONG, role=FieldRole.METRIC)]),
+            TableConfig(name="t2"),
+        )
+        leader.checkpoint_metadata()  # snapshot + journal truncate under the tail
+        leader.drop_table("t2")
+        standby.catch_up()
+        assert "t2" not in standby.tables
+        assert _fingerprint(standby) == _fingerprint(leader)
+
+    def test_follower_parks_a_torn_final_line(self, tmp_path):
+        """The regression both TailFollower call sites share: a torn final
+        line parks until the writer finishes it — never applied early,
+        never skipped once complete."""
+        meta = tmp_path / "meta"
+        meta.mkdir()
+        path = meta / JOURNAL_FILE
+        with open(path, "w", encoding="utf-8") as f:
+            f.write('{"seq": 1, "epoch": 1, "op": "noop"}\n')
+            f.write('{"seq": 2, "epoch": 1, "op": "noop"}\n')
+            f.write('{"seq": 3, "epoch": 1, "o')  # append died mid-line
+        follower = JournalFollower(str(meta))
+        _state, entries = follower.poll()
+        assert [e["seq"] for e in entries] == [1, 2]
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('p": "noop"}\n')  # the writer finished the line
+        _state, entries = follower.poll()
+        assert [e["seq"] for e in entries] == [3]
+
+    def test_follower_drops_deposed_epoch_interleaving(self, tmp_path):
+        meta = tmp_path / "meta"
+        meta.mkdir()
+        with open(meta / JOURNAL_FILE, "w", encoding="utf-8") as f:
+            f.write('{"seq": 1, "epoch": 1, "op": "noop"}\n')
+            f.write('{"seq": 2, "epoch": 2, "op": "noop"}\n')
+            f.write('{"seq": 3, "epoch": 1, "op": "zombie"}\n')  # deposed writer
+            f.write('{"seq": 4, "epoch": 2, "op": "noop"}\n')
+        follower = JournalFollower(str(meta))
+        _state, entries = follower.poll()
+        assert [e["seq"] for e in entries] == [1, 2, 4]
+        assert METRICS.counter("coordinator.fencedReplayDropped").value == 1
+
+
+# ---------------------------------------------------------------------------
+# the split-brain proof (satellite acceptance)
+# ---------------------------------------------------------------------------
+class TestSplitBrain:
+    def test_zombie_leader_is_fenced_and_replay_matches_bit_for_bit(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock)
+        plan = FaultPlan().attach_coordinator(leader)
+        standby = _standby(tmp_path, clock)
+        plan.attach_coordinator(standby)
+
+        # freeze the leader (GC pause / VM stall) past lease expiry
+        plan.pause_leader("coord-a")
+        clock.advance(TTL + 0.1)
+        assert standby.run_election_tick() == "leader"
+        assert standby.election.epoch == 2
+        standby.add_table(
+            Schema("t2", [FieldSpec("x", DataType.LONG, role=FieldRole.METRIC)]),
+            TableConfig(name="t2"),
+        )
+
+        # thaw the zombie: it still believes it leads — its next durable
+        # write must fence BEFORE any byte reaches the journal
+        plan.resume_leader("coord-a")
+        assert leader.role == "leader"
+        with pytest.raises(FencedEpochError):
+            leader.drop_table("t")
+        assert METRICS.counter("coordinator.fencedAppends").value == 1
+        assert leader.role == "standby"  # fencing demotes in place
+
+        # the on-disk journal shows no interleaved epochs
+        with open(tmp_path / "meta" / JOURNAL_FILE, encoding="utf-8") as f:
+            epochs = [json.loads(line)["epoch"] for line in f if line.strip()]
+        assert epochs == sorted(epochs) and set(epochs) == {1, 2}
+
+        # a third coordinator replaying the directory lands on the new
+        # leader's EXACT state (the fenced drop never happened)
+        third = Coordinator(
+            meta_dir=str(tmp_path / "meta"), deep_store=str(tmp_path / "deep"),
+            node_id="coord-c", lease_ttl_s=TTL, clock=clock,
+        )
+        assert "t" in third.tables and "t2" in third.tables
+        assert _fingerprint(third) == _fingerprint(standby)
+
+    def test_deposed_leader_rejoins_as_a_tailing_standby(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock)
+        plan = FaultPlan().attach_coordinator(leader)
+        standby = _standby(tmp_path, clock)
+        plan.attach_coordinator(standby)
+        plan.pause_leader("coord-a")
+        clock.advance(TTL + 0.1)
+        assert standby.run_election_tick() == "leader"
+        plan.resume_leader("coord-a")
+        # the thawed leader's own tick discovers the lost lease and demotes
+        assert leader.run_election_tick() == "standby"
+        standby.add_table(
+            Schema("t2", [FieldSpec("x", DataType.LONG, role=FieldRole.METRIC)]),
+            TableConfig(name="t2"),
+        )
+        leader.run_election_tick()  # now tails the NEW leader's journal
+        assert "t2" in leader.tables
+        assert _fingerprint(leader) == _fingerprint(standby)
+
+    def test_paused_leader_refuses_control_plane_but_serves_reads(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock)
+        plan = FaultPlan().attach_coordinator(leader)
+        baseline = {sql: Broker(leader).query(sql).rows for sql in QUERIES}
+        plan.pause_leader("coord-a")
+        with pytest.raises(NotLeaderError):
+            leader.mark_down("server0")
+        broker = Broker(leader)
+        for sql in QUERIES:
+            res = broker.query(sql)
+            assert_same_rows(res.rows, baseline[sql])
+            assert res.stats.partial_result is False
+
+    def test_renew_suppression_is_logged_by_the_plan(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock, n_segments=1)
+        plan = FaultPlan().attach_coordinator(leader)
+        plan.pause_leader("coord-a")
+        # the frozen process's renewal simply never happens (returns True
+        # unchanged — the lie the epoch fence exists to catch)
+        assert leader.election.renew() is True
+        assert METRICS.counter("coordinator.leaseRenewals").value == 0
+        assert any(ev[2] == "renew_suppressed" for ev in plan.log)
+
+    def test_journal_append_latency_rides_the_plan_sleep(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock, n_segments=1)
+        plan = FaultPlan().attach_coordinator(leader)
+        plan.journal_append_latency("coord-a", 50.0)
+        slept = []
+        plan.sleep = slept.append
+        leader.add_table(
+            Schema("t2", [FieldSpec("x", DataType.LONG, role=FieldRole.METRIC)]),
+            TableConfig(name="t2"),
+        )
+        assert slept == [0.05]
+        assert any(ev[2] == "journal_append_latency" for ev in plan.log)
+
+
+# ---------------------------------------------------------------------------
+# crash points inside the election protocol
+# ---------------------------------------------------------------------------
+class TestElectionCrashPoints:
+    def test_crash_mid_promote_is_retryable(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock)
+        plan = FaultPlan().attach_coordinator(leader)
+        standby = _standby(tmp_path, clock)
+        plan.attach_coordinator(standby)
+        plan.pause_leader("coord-a")
+        clock.advance(TTL + 0.1)
+        plan.kill_at("election.promote.after_acquire")
+        with pytest.raises(InjectedCrash):
+            standby.run_election_tick()
+        # died holding the lease but before adopting the journal: the next
+        # tick re-acquires (own holder: no polite refusal) and finishes
+        assert standby.role == "standby"
+        assert standby.run_election_tick() == "leader"
+        assert standby.journal is not None and standby.election.is_leader
+
+    @pytest.mark.parametrize(
+        "point", ["journal.append.before_fence", "journal.append.after_fence"]
+    )
+    def test_crash_around_the_fence_never_commits(self, tmp_path, point):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock)
+        before = _fingerprint(leader)
+        crashpoints.arm(point)
+        with pytest.raises(InjectedCrash):
+            leader.add_table(
+                Schema("t2", [FieldSpec("x", DataType.LONG, role=FieldRole.METRIC)]),
+                TableConfig(name="t2"),
+            )
+        replayed = Coordinator(
+            meta_dir=str(tmp_path / "meta"), node_id="coord-r",
+            lease_ttl_s=TTL, clock=clock,
+        )
+        assert "t2" not in replayed.tables
+        assert _fingerprint(replayed) == before
+
+    @pytest.mark.parametrize(
+        "point,renewed",
+        [
+            # died between tmp write and rename: the OLD deadline stands
+            ("election.renew.after_write", False),
+            # died after the rename: the renewal committed durably
+            ("election.renew.after_replace", True),
+        ],
+    )
+    def test_crash_mid_renew_leaves_a_consistent_lease(self, tmp_path, point, renewed):
+        clock = SimClock()
+        a = LeaseManager(str(tmp_path), "a", ttl_s=TTL, clock=clock)
+        assert a.try_acquire()
+        clock.advance(0.5)
+        crashpoints.arm(point)
+        with pytest.raises(InjectedCrash):
+            a.renew()
+        cur = LeaseManager(str(tmp_path), "b", ttl_s=TTL, clock=clock).read()
+        assert cur is not None and cur.holder == "a"
+        assert cur.expires_at == pytest.approx((0.5 + TTL) if renewed else TTL)
+
+
+# ---------------------------------------------------------------------------
+# CoordinatorHandle: brokers ride the failover (chaos acceptance)
+# ---------------------------------------------------------------------------
+def _handled_cluster(tmp_path, clock):
+    """Leader + hot standby behind a CoordinatorHandle whose park sleeps
+    advance the sim clock (the park's auto-tick then promotes the standby
+    once the lease expires) — the single-threaded failover-under-load rig."""
+    leader, servers = _ha_cluster(tmp_path, clock)
+    plan = FaultPlan().attach_coordinator(leader)
+    standby = _standby(tmp_path, clock)
+    plan.attach_coordinator(standby)
+    handle = CoordinatorHandle(
+        [leader, standby], sleep=lambda s: clock.advance(s), clock=clock
+    )
+    for s in servers:
+        handle._servers[s.name] = s  # already registered pre-handle
+    return leader, standby, plan, handle
+
+
+class TestCoordinatorHandleFailover:
+    def test_control_plane_write_parks_across_the_failover(self, tmp_path):
+        clock = SimClock()
+        leader, standby, plan, handle = _handled_cluster(tmp_path, clock)
+        plan.pause_leader("coord-a")
+        # no clock advance needed: the park's own backoff sleeps walk the
+        # sim clock past lease expiry, the auto-tick promotes, the write lands
+        handle.add_table(
+            Schema("t2", [FieldSpec("x", DataType.LONG, role=FieldRole.METRIC)]),
+            TableConfig(name="t2"),
+        )
+        assert standby.role == "leader" and "t2" in standby.tables
+        assert METRICS.counter("coordinator.failoverParksServed").value >= 1
+        assert handle.election_snapshot()["leader"] == "coord-b"
+
+    def test_park_window_expiry_raises_structured_not_leader(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock, n_segments=1)
+        plan = FaultPlan().attach_coordinator(leader)
+        handle = CoordinatorHandle(
+            [leader], park_ms=200, retries=1,
+            sleep=lambda s: clock.advance(s), clock=clock,
+        )
+        plan.pause_leader("coord-a")  # no standby: nothing can take over
+        with pytest.raises(NotLeaderError):
+            handle.mark_down("server0")
+        assert METRICS.counter("coordinator.failoverParkTimeouts").value >= 1
+
+    @pytest.mark.parametrize(
+        "point,committed",
+        [
+            # leader dies after the deep-store upload, before the journal
+            # append: the assignment never committed — the retry on the new
+            # leader is the FIRST commit (no double-add)
+            ("coordinator.add_segment.after_upload", False),
+            # leader dies after the journal append: committed — the new
+            # leader replays it and the retry must be refused as a duplicate
+            ("coordinator.add_segment.after_journal", True),
+        ],
+    )
+    def test_leader_killed_mid_add_segment(self, tmp_path, point, committed):
+        clock = SimClock()
+        leader, standby, plan, handle = _handled_cluster(tmp_path, clock)
+        broker = Broker(handle)
+        baseline = {sql: broker.query(sql).rows for sql in QUERIES}
+        seg = build_segment(
+            _schema(), _data(80, seed=999), "seg_late",
+            output_dir=str(tmp_path / "build" / "seg_late"),
+        )
+        plan.kill_at(point)
+        with pytest.raises(InjectedCrash):
+            handle.add_segment("t", seg)
+        plan.pause_leader("coord-a")  # the crashed process never comes back
+        handle.heartbeat("server0")  # any control-plane call drives the failover
+        assert standby.role == "leader"
+        # the journal is the truth: committed iff the append preceded death
+        assert ("seg_late" in standby.tables["t"].ideal) == committed
+        if not committed:
+            handle.add_segment("t", seg)  # the retry is the FIRST commit
+        res = broker.query("SELECT COUNT(*) FROM t")
+        assert res.rows[0][0] == 3 * 150 + 80
+        assert res.stats.partial_result is False
+        for sql in QUERIES:  # pre-failover results stay exact, never doubled
+            got = broker.query(sql)
+            assert got.stats.partial_result is False
+        del baseline
+
+    def test_leader_killed_mid_rebalance_converges(self, tmp_path):
+        clock = SimClock()
+        leader, standby, plan, handle = _handled_cluster(tmp_path, clock)
+        broker = Broker(handle)
+        baseline = {sql: broker.query(sql).rows for sql in QUERIES}
+        new_server = ServerInstance("server3", data_dir=str(tmp_path / "server3"))
+        handle.register_server(new_server)
+        plan.kill_at("rebalance.after_add")
+        with pytest.raises(InjectedCrash):
+            handle.rebalance("t")
+        plan.pause_leader("coord-a")
+        # queries during the blackout: exact or structured-partial, never garbage
+        for sql in QUERIES:
+            res = broker.query(sql)
+            if res.stats.partial_result:
+                assert res.stats.exceptions
+            else:
+                assert_same_rows(res.rows, baseline[sql])
+        # the retried rebalance on the promoted standby converges
+        handle.rebalance("t")
+        assert standby.role == "leader"
+        meta = standby.tables["t"]
+        for seg, srvs in meta.ideal.items():
+            assert len(srvs) == standby.replication
+        for sql in QUERIES:
+            res = broker.query(sql)
+            assert res.stats.partial_result is False
+            assert_same_rows(res.rows, baseline[sql])
+
+    def test_data_plane_never_parks_during_blackout(self, tmp_path):
+        clock = SimClock()
+        leader, standby, plan, handle = _handled_cluster(tmp_path, clock)
+        broker = Broker(handle)
+        baseline = {sql: broker.query(sql).rows for sql in QUERIES}
+        plan.pause_leader("coord-a")
+        t0 = clock.t
+        for sql in QUERIES:  # leaderless: served off the last routing view
+            assert_same_rows(broker.query(sql).rows, baseline[sql])
+        assert clock.t == t0  # zero park sleeps on the read path
+        handle.heartbeat("server0")  # control plane parks + promotes
+        assert standby.role == "leader"
+        for sql in QUERIES:
+            assert_same_rows(broker.query(sql).rows, baseline[sql])
+
+
+class TestElectionSurfaces:
+    def test_rest_debug_election_and_not_leader_503(self, tmp_path):
+        import urllib.error
+        import urllib.request
+
+        from pinot_tpu.cluster.rest import QueryServer
+
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock, n_segments=1)
+
+        class _Engine:
+            def election_snapshot(self):
+                return leader.election_snapshot()
+
+            def sql(self, _sql):
+                raise NotLeaderError("coordinator coord-a is a standby")
+
+        srv = QueryServer(_Engine()).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/election"
+            ) as resp:
+                snap = json.loads(resp.read().decode())
+            assert snap["leader"] == "coord-a"
+            assert snap["candidates"][0]["epoch"] == 1
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/query/sql",
+                data=json.dumps({"sql": "SELECT 1"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read().decode())["errorCode"] == "NOT_LEADER"
+        finally:
+            srv.stop()
+
+    def test_cli_election_renders_snapshot(self, tmp_path, capsys):
+        from pinot_tpu.cluster.rest import QueryServer
+        from pinot_tpu.tools import cli
+
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock, n_segments=1)
+
+        class _Engine:
+            def election_snapshot(self):
+                return leader.election_snapshot()
+
+        srv = QueryServer(_Engine()).start()
+        try:
+            rc = cli.main(["election", "--url", f"http://127.0.0.1:{srv.port}"])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "leader  : coord-a" in out
+            assert "role=leader" in out and "epoch=1" in out
+            rc = cli.main(
+                ["election", "--url", f"http://127.0.0.1:{srv.port}", "--json"]
+            )
+            snap = json.loads(capsys.readouterr().out)
+            assert rc == 0
+            assert snap["leader"] == "coord-a"
+        finally:
+            srv.stop()
+
+    def test_broker_election_snapshot_delegates(self, tmp_path):
+        clock = SimClock()
+        leader, _ = _ha_cluster(tmp_path, clock, n_segments=1)
+        snap = Broker(leader).election_snapshot()
+        assert snap["leader"] == "coord-a"
+        assert snap["candidates"][0]["role"] == "leader"

@@ -28,7 +28,10 @@ def test_interprocedural_gate_clean_and_under_budget():
     assert report.findings == [], "\n".join(str(f) for f in report.findings)
     assert report.stale_baseline == [], report.stale_baseline
     assert report.baselined > 0  # the committed baseline is live, not decorative
-    assert elapsed < 10.0, f"analysis gate took {elapsed:.1f}s (budget 10s)"
+    # budget tracks tree growth: ~8s on an idle machine at r18 (the r10
+    # original was 10s over a tree half this size); the gate is against
+    # pathological blowup, not linear growth
+    assert elapsed < 20.0, f"analysis gate took {elapsed:.1f}s (budget 20s)"
 
 
 def test_cli_lint_json_report(capsys):

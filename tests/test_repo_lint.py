@@ -954,6 +954,66 @@ class TestW021UnbudgetedSegmentDevicePut:
         assert _rules(src) == ["W021"]
 
 
+class TestW022WallClockInLeaseCode:
+    def test_flags_deadline_addition_in_lease_class(self):
+        # the exact bug W005 misses: lease deadline built by ADDITION
+        src = """
+        import time
+
+        class LeaseManager:
+            def acquire(self, ttl_s):
+                return time.time() + ttl_s
+        """
+        assert _rules(src) == ["W022"]
+
+    def test_flags_alias_compare_in_election_function(self):
+        src = """
+        import time
+
+        def run_election_tick(lease):
+            now = time.time()
+            return lease.expires_at <= now
+        """
+        # W005 also fires on the comparison; W022 must be among the findings
+        assert "W022" in _rules(src)
+
+    def test_flags_epoch_identifier_mix_outside_scoped_names(self):
+        src = """
+        import time
+
+        def check_fresh(entry_epoch, ttl_s):
+            return entry_epoch > time.time() - ttl_s
+        """
+        assert "W022" in _rules(src)
+
+    def test_quiet_on_injectable_clock_in_lease_code(self):
+        src = """
+        class LeaseManager:
+            def acquire(self, ttl_s):
+                deadline = self.clock() + ttl_s
+                return deadline
+
+            def expired(self, lease):
+                return lease.expires_at <= self.now()
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_epoch_timestamp_stamping_and_retention_math(self):
+        # epoch-millis stamping is multiplication; retention math never
+        # mixes time.time() into the same expression — both clean
+        src = """
+        import time
+
+        def seal(segment):
+            segment.creationTimeMs = int(time.time() * 1000)
+
+        def run_retention(self, now_ms, retention_ms):
+            horizon = now_ms - retention_ms
+            return [s for s in self.segments if s.end_ms < horizon]
+        """
+        assert _rules(src) == []
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

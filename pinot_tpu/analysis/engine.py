@@ -148,8 +148,13 @@ class Project:
                 source=src,
                 imports=_module_imports(tree),
                 suppressions=parse_suppressions(src),
-                threaded="threading" in _module_imports(tree).values()
-                or any(v.startswith("threading.") for v in _module_imports(tree).values()),
+                threaded=any(
+                    v == "threading"
+                    or v.startswith("threading.")
+                    or v == "pinot_tpu.utils.threads"
+                    or v.startswith("pinot_tpu.utils.threads.")
+                    for v in _module_imports(tree).values()
+                ),
             )
             proj.modules[modname] = mi
             proj._index_module(mi)
@@ -252,9 +257,10 @@ class Pass:
 
 def default_passes() -> List[Pass]:
     from pinot_tpu.analysis.device_sync import DeviceSyncPass
+    from pinot_tpu.analysis.lifecycle import ConditionDisciplinePass, LifecyclePass
     from pinot_tpu.analysis.races import RacePass
 
-    return [RacePass(), DeviceSyncPass()]
+    return [RacePass(), DeviceSyncPass(), LifecyclePass(), ConditionDisciplinePass()]
 
 
 # -- baseline -------------------------------------------------------------

@@ -52,8 +52,13 @@ _MUTATING_METHODS = {
     "add", "discard", "update", "setdefault", "move_to_end", "appendleft",
 }
 
-_NON_REENTRANT_CTORS = {"threading.Lock"}
-_REENTRANT_CTORS = {"threading.RLock", "threading.Condition"}
+_NON_REENTRANT_CTORS = {"threading.Lock", "pinot_tpu.utils.threads.Lock"}
+_REENTRANT_CTORS = {
+    "threading.RLock",
+    "threading.Condition",
+    "pinot_tpu.utils.threads.RLock",
+    "pinot_tpu.utils.threads.Condition",
+}
 
 
 def _self_attr_name(node: ast.AST) -> Optional[str]:

@@ -154,6 +154,15 @@ healthy leader or immortalize a dead one, and no test can ever drive the
 failover deterministically.  Sharper than W005: W005 only flags elapsed
 subtraction/comparison, while a lease bug's signature is the ADDITION
 (`deadline = time.time() + ttl`), which W005 deliberately ignores.
+
+W023/W024 are the resource-lifecycle passes (analysis/lifecycle.py): W023
+tracks the ledger open/close pairs (reserve->release, try_charge->uncharge,
+try_fire->unfire, register->deregister, arm->disarm) and flags an opened
+handle that neither escapes to a new owner nor closes on the function's
+exception edges; W024 enforces condition-variable discipline (wait inside
+a while-predicate loop; notify under the condition's lock).  They are the
+static face of the concurrency model checker (analysis/model_check.py),
+which proves the same pairings dynamically.
 """
 from __future__ import annotations
 
@@ -187,6 +196,9 @@ RULES: Dict[str, str] = {
     "W012": "blocking call (sleep/sync/socket/device put) while holding a lock",
     "W013": "implicit device->host sync on the warm query path",
     "W014": "host control flow branches on a device value in the warm path",
+    # resource-lifecycle passes (analysis/lifecycle.py):
+    "W023": "paired resource (reserve/release, try_charge/uncharge, try_fire/unfire, register/deregister, arm/disarm) opened but not closed on exception edges and never handed off",
+    "W024": "condition-variable discipline: wait outside a while-predicate loop, or notify without holding the condition's lock (lost-wakeup shapes)",
 }
 
 _HOST_SYNC_ATTRS = frozenset({"item", "block_until_ready", "device_get", "tolist"})

@@ -42,6 +42,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from pinot_tpu.query.ir import QueryContext
 from pinot_tpu.query.safety import AdmissionError, Deadline
+from pinot_tpu.utils import threads
 from pinot_tpu.utils.metrics import METRICS
 
 
@@ -153,7 +154,7 @@ class AdmissionController:
         # Condition wraps the bucket lock: waiters re-check on wake, and the
         # refill/charge sequence is a read-modify-write (same race class as
         # the broker token bucket, ADVICE r5)
-        self._lock = threading.Condition()
+        self._lock = threads.Condition()
         self._tokens = self.burst
         self._last_refill: Optional[float] = None
         self._waiting = 0
@@ -283,9 +284,10 @@ class ResourceBudget:
     def __init__(self, budget_bytes: int, gauge: Optional[str] = None):
         self.budget_bytes = int(budget_bytes)
         self.gauge = gauge
+        self.clock = time.monotonic  # injectable for deterministic tests
         # Condition, not a bare Lock: reserve_or_wait() parks staged
         # fetches on it until release()/uncharge() frees bytes.
-        self._lock = threading.Condition()
+        self._lock = threads.Condition()
         self._by_ticket: Dict[int, int] = {}
         self._ticket_seq = itertools.count(1)
         self._in_use = 0
@@ -371,12 +373,12 @@ class ResourceBudget:
             budget_ms = max_wait_ms
             if deadline is not None:
                 budget_ms = min(budget_ms, deadline.remaining_ms())
-            give_up = time.monotonic() + max(0.0, budget_ms) / 1000.0
+            give_up = self.clock() + max(0.0, budget_ms) / 1000.0
             METRICS.counter("admission.stagedFetchQueued").inc()
             self._waiters += 1
             try:
                 while self._in_use + n > self.budget_bytes:
-                    left = give_up - time.monotonic()
+                    left = give_up - self.clock()
                     if left <= 0 or not self._lock.wait(timeout=left):
                         METRICS.counter("admission.stagedFetchTimeouts").inc()
                         raise ReservationError(
@@ -783,17 +785,24 @@ class ResourceGovernor:
         ticket = self.host_budget.reserve(
             cost.host_bytes, what="query working set", query_id=query_id
         )
-        runaway = ctx.options.get("maxRuntimeMs")
-        self.watchdog.register(
-            query_id,
-            reserved_bytes=cost.host_bytes + cost.hbm_bytes,
-            priority=priority,
-            runaway_ms=float(runaway) if runaway is not None else None,
-        )
-        level = self.degrade.update(self._occupancy())
-        if level >= 3:
-            self.watchdog.patrol(self.host_budget.occupancy())
-        return AdmissionGrant(self, query_id, ticket)
+        try:
+            runaway = ctx.options.get("maxRuntimeMs")
+            self.watchdog.register(
+                query_id,
+                reserved_bytes=cost.host_bytes + cost.hbm_bytes,
+                priority=priority,
+                runaway_ms=float(runaway) if runaway is not None else None,
+            )
+            level = self.degrade.update(self._occupancy())
+            if level >= 3:
+                self.watchdog.patrol(self.host_budget.occupancy())
+            return AdmissionGrant(self, query_id, ticket)
+        except BaseException:
+            # unwind the half-built grant: an exception past the reserve
+            # would otherwise leak the host-budget ticket and (after
+            # register) a phantom watchdog entry; deregister is idempotent
+            self._finish(query_id, ticket)
+            raise
 
     def _finish(self, query_id: str, ticket: Optional[int]) -> None:
         if ticket is not None:

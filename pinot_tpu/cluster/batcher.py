@@ -21,10 +21,11 @@ caller threads.
 from __future__ import annotations
 
 import os
-import threading
 import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+
+from pinot_tpu.utils import threads
 
 
 def batch_wait_ms() -> float:
@@ -47,7 +48,7 @@ class BatchEntry:
 
     def __init__(self, payload: Any):
         self.payload = payload
-        self.future: Future = Future()
+        self.future: Future = threads.Future()
 
 
 class _Group:
@@ -78,9 +79,9 @@ class MicroBatcher:
         # monotonic clock => lazy daemon worker wakes groups on deadline
         self._auto = clock is None
         self.clock = clock or time.monotonic
-        self._cv = threading.Condition()
+        self._cv = threads.Condition()
         self._groups: Dict[Hashable, _Group] = {}
-        self._worker: Optional[threading.Thread] = None
+        self._worker: Optional[Any] = None
         self._closed = False
 
     # -- submission ---------------------------------------------------------
@@ -155,7 +156,7 @@ class MicroBatcher:
     def _ensure_worker(self) -> None:
         # caller holds the condition lock
         if self._worker is None or not self._worker.is_alive():
-            self._worker = threading.Thread(
+            self._worker = threads.Thread(
                 target=self._worker_main, name="query-batcher", daemon=True
             )
             self._worker.start()

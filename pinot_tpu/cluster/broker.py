@@ -34,6 +34,7 @@ from pinot_tpu.query import reduce as reduce_mod
 from pinot_tpu.query.ir import FilterNode, FilterOp, PredicateType, QueryContext
 from pinot_tpu.query.result import ExecutionStats, ResultTable
 from pinot_tpu.query.safety import Deadline, QueryTimeoutError
+from pinot_tpu.utils import threads
 from pinot_tpu.utils.hashing import partition_of
 from pinot_tpu.utils.metrics import METRICS, Trace
 from pinot_tpu.utils.slowlog import SlowQueryLog
@@ -169,7 +170,7 @@ class ServerHealth:
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
         self.clock = time.monotonic  # injectable for deterministic tests
-        self._lock = threading.Lock()
+        self._lock = threads.Lock()
         self._consecutive: Dict[str, int] = {}
         self._opened_at: Dict[str, float] = {}  # server -> quarantine start
         self._probing: Set[str] = set()  # half-open probes in flight

@@ -51,11 +51,11 @@ import json
 import logging
 import os
 import random
-import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from pinot_tpu.utils import threads
 from pinot_tpu.spi.filesystem import TailFollower, durable_write_json, sweep_tmp
 from pinot_tpu.utils.crashpoints import crash_point
 from pinot_tpu.utils.metrics import METRICS
@@ -150,7 +150,7 @@ class LeaseManager:
         self.fault_plan = None
         self.epoch = 0  # the epoch THIS node last held (0 = never led)
         self.is_leader = False
-        self._lock = threading.Lock()
+        self._lock = threads.Lock()
         os.makedirs(meta_dir, exist_ok=True)
 
     @property
@@ -470,7 +470,7 @@ class CoordinatorHandle:
         if not candidates:
             raise ValueError("CoordinatorHandle needs at least one coordinator")
         self._candidates = list(candidates)
-        self._lock = threading.RLock()
+        self._lock = threads.RLock()
         self._last = None  # last adopted leader: the data-plane read fallback
         self._adopted: set = set()  # id()s of leaders already re-wired
         self._listeners: List[Any] = []  # on_live_change fns to re-register

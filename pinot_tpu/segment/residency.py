@@ -36,12 +36,12 @@ the pending bytes — the crash-harness tests assert no ledger leak.
 """
 from __future__ import annotations
 
-import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
+from pinot_tpu.utils import threads
 from pinot_tpu.utils.metrics import METRICS
 
 HOST_ONLY = "host_only"
@@ -66,7 +66,7 @@ class _Entry:
     pending: int = 0  # charged but not yet finish_stage'd bytes
     last_access: int = 0
     prefetched: bool = False
-    event: threading.Event = field(default_factory=threading.Event)
+    event: Any = field(default_factory=threads.Event)
 
 
 class ResidencyManager:
@@ -92,7 +92,7 @@ class ResidencyManager:
         # table); None falls back to pure LRU
         self._ledger = ledger
         self.stall_timeout_s = float(stall_timeout_s)
-        self._lock = threading.Lock()
+        self._lock = threads.Lock()
         self._entries: Dict[Tuple, _Entry] = {}
         self._clock = 0  # logical access clock (recency, not wall time)
         self._resident_bytes = 0

@@ -45,3 +45,15 @@ def _reset_metrics():
     METRICS.reset()
     PERF_LEDGER.reset()
     yield
+
+
+@pytest.fixture(autouse=True)
+def _reset_thread_provider():
+    """The primitive provider (utils/threads.py) is process-global; a test
+    that dies inside a model-checker schedule must not leave the
+    deterministic provider installed for whichever test runs next."""
+    from pinot_tpu.utils import threads
+
+    threads.reset_provider()
+    yield
+    threads.reset_provider()

@@ -1,0 +1,232 @@
+"""Resource-lifecycle passes (pinot_tpu.analysis.lifecycle).
+
+W023 (paired-resource escape analysis) and W024 (condition-variable
+discipline), each over minimal seeded-bug fixtures plus clean-negative
+counterparts — the test style the race-detector suite established: a
+rule earns its place by firing on the bug and staying quiet on the
+idiomatic fix AND on every sanctioned ownership-transfer shape."""
+import textwrap
+
+from pinot_tpu.analysis.engine import Project, run_passes
+from pinot_tpu.analysis.lifecycle import ConditionDisciplinePass, LifecyclePass
+
+
+def _findings(src, pass_cls=LifecyclePass, **extra):
+    files = {"pkg/m.py": textwrap.dedent(src)}
+    for name, body in extra.items():
+        files[f"pkg/{name}.py"] = textwrap.dedent(body)
+    proj = Project.from_sources(files)
+    return run_passes(proj, [pass_cls()])
+
+
+def _rules(src, **kw):
+    return [f.rule for f in _findings(src, **kw)]
+
+
+class TestW023PairedResources:
+    def test_flags_reservation_never_released(self):
+        src = """
+        def admit(budget, n):
+            ticket = budget.reserve(n)
+            do_work(n)
+        """
+        found = _findings(src)
+        assert [f.rule for f in found] == ["W023"]
+        assert "never repays" in found[0].message
+        assert found[0].symbol == "admit"
+
+    def test_flags_straight_line_release(self):
+        src = """
+        def admit(budget, n):
+            ticket = budget.reserve(n)
+            risky(n)
+            budget.release(ticket)
+        """
+        found = _findings(src)
+        assert [f.rule for f in found] == ["W023"]
+        assert "straight-line" in found[0].message
+        assert "finally" in found[0].hint
+
+    def test_quiet_when_released_in_finally(self):
+        src = """
+        def admit(budget, n):
+            ticket = budget.reserve(n)
+            try:
+                risky(n)
+            finally:
+                budget.release(ticket)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_when_unwound_in_except_handler(self):
+        src = """
+        def admit(budget, n):
+            ticket = budget.reserve(n)
+            try:
+                risky(n)
+            except Exception:
+                budget.release(ticket)
+                raise
+            budget.release(ticket)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_when_handle_is_returned(self):
+        src = """
+        def admit(budget, n):
+            return budget.reserve(n)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_when_handle_passes_to_a_new_owner(self):
+        src = """
+        def admit(self, budget, qid):
+            ticket = budget.reserve(1)
+            return Grant(self, qid, ticket)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_when_handle_stored_on_self(self):
+        src = """
+        class Holder:
+            def open(self, budget):
+                self.ticket = budget.reserve(1)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_when_finally_closes_interprocedurally(self):
+        src = """
+        class Hedger:
+            def go(self, hc):
+                hc.try_fire(1)
+                try:
+                    work()
+                finally:
+                    self._cleanup(hc)
+
+            def _cleanup(self, hc):
+                hc.unfire()
+        """
+        assert _rules(src) == []
+
+    def test_quiet_inside_the_ledger_implementation_itself(self):
+        # reserve_or_wait retrying reserve / release notifying is the
+        # protocol's implementation, not a leaky client
+        src = """
+        class Budget:
+            def reserve(self, n):
+                self._in_use += n
+                return 1
+
+            def reserve_or_wait(self, n):
+                while True:
+                    t = self.reserve(n)
+                    if t:
+                        return t
+
+            def release(self, t):
+                self._in_use -= 1
+        """
+        assert _rules(src) == []
+
+    def test_receiver_hint_scopes_generic_verbs(self):
+        # `register` only binds watchdog-ish receivers; a cursor registry
+        # with no deregister is not a lifecycle bug
+        src = """
+        def track(cursors, qid):
+            cursors.register(qid)
+        """
+        assert _rules(src) == []
+        src = """
+        def track(self, qid):
+            self.watchdog.register(qid)
+        """
+        assert _rules(src) == ["W023"]
+
+
+class TestW024ConditionDiscipline:
+    def test_flags_wait_outside_while(self):
+        src = """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def get(self):
+                with self._cv:
+                    if not self._items:
+                        self._cv.wait(timeout=1.0)
+                    return self._items.pop()
+        """
+        found = _findings(src, pass_cls=ConditionDisciplinePass)
+        assert [f.rule for f in found] == ["W024"]
+        assert "while" in found[0].message
+        assert found[0].symbol == "Q.get"
+
+    def test_quiet_wait_inside_while(self):
+        src = """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def get(self):
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait(timeout=1.0)
+                    return self._items.pop()
+        """
+        assert _rules(src, pass_cls=ConditionDisciplinePass) == []
+
+    def test_flags_notify_without_lock(self):
+        src = """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def put(self, v):
+                self._items.append(v)
+                self._cv.notify_all()
+        """
+        found = _findings(src, pass_cls=ConditionDisciplinePass)
+        assert [f.rule for f in found] == ["W024"]
+        assert "lost wakeup" in found[0].message
+
+    def test_quiet_notify_under_the_lock(self):
+        src = """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def put(self, v):
+                with self._cv:
+                    self._items.append(v)
+                    self._cv.notify_all()
+        """
+        assert _rules(src, pass_cls=ConditionDisciplinePass) == []
+
+    def test_covers_the_injected_provider_ctor(self):
+        # the seam (utils/threads.py) is what production classes use now
+        src = """
+        from pinot_tpu.utils import threads
+
+        class Q:
+            def __init__(self):
+                self._cv = threads.Condition()
+                self._n = 0
+
+            def bump(self):
+                self._n += 1
+                self._cv.notify()
+        """
+        assert _rules(src, pass_cls=ConditionDisciplinePass) == ["W024"]

@@ -42,6 +42,12 @@ def test_cli_lint_json_report(capsys):
     assert payload["count"] == 0 and payload["findings"] == []
     assert payload["staleBaseline"] == []
     assert payload["baselined"] > 0
+    # the model-check sweep rides along in the one machine-readable gate
+    mc = payload["modelCheck"]
+    assert mc["ok"] is True
+    assert set(mc["protocols"]) == {"admission", "batcher", "lease", "residency"}
+    for entry in mc["protocols"].values():
+        assert entry["failure"] is None
 
 
 def test_cli_lint_flags_bad_path(tmp_path, capsys):

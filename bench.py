@@ -452,6 +452,125 @@ def _concurrent_qps_bench() -> dict:
     }
 
 
+def _mesh_scaling_bench() -> dict:
+    """2-D (replica x shard) mesh scale-out section (multi-host tentpole).
+
+    Three measurements over one dataset:
+
+      topologies: warm scan rows/s per mesh shape — 1-D "seg" 8-dev
+                  baseline vs 2x4 / 4x2 / 1x8 two-axis meshes, asserting
+                  BIT-IDENTICAL rows per topology (the hierarchical
+                  shard-then-replica combine must not change results)
+      shard axis: rows/s at full shard width vs a single-device mesh —
+                  `mesh_shard_speedup` is the capacity-scaling ratio
+      replica axis: concurrent QPS through ReplicatedEngine at R=2 (two
+                  4-device rows, whole batches round-robin across rows)
+                  vs R=1 — `mesh_replica_qps_scale` is the QPS ratio
+
+    HONESTY NOTE: in-image the 8 "devices" are XLA host-platform threads on
+    however many cores the container grants (often ONE), so both ratios
+    measure collective/dispatch overhead, not real parallel speedup — expect
+    ~1.0 and read them as regression canaries (a broken hierarchical combine
+    or a row that stops serving moves them), not as scaling claims.  Real
+    per-axis scaling needs real hardware (ICI shard rows, DCN replica rows).
+    """
+    import threading
+
+    from pinot_tpu.parallel.engine import DistributedEngine, ReplicatedEngine
+    from pinot_tpu.parallel.mesh import default_mesh, make_mesh2d
+    from pinot_tpu.parallel.stacked import StackedTable
+    from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+    from pinot_tpu.sql.parser import parse_query
+
+    rng = np.random.default_rng(31)
+    rows = int(os.environ.get("BENCH_MESH_ROWS", 1 << 20))
+    schema = Schema(
+        "t",
+        [
+            FieldSpec("k", DataType.INT),
+            FieldSpec("m", DataType.LONG, role=FieldRole.METRIC),
+        ],
+    )
+    data = {
+        "k": rng.integers(0, 1024, rows).astype(np.int32),
+        "m": rng.integers(1, 1000, rows).astype(np.int64),
+    }
+    stacked = StackedTable.build(schema, data, num_shards=8)
+    ctx = parse_query("SELECT k, COUNT(*), SUM(m) FROM t WHERE m > 100 GROUP BY k LIMIT 1100")
+
+    def scan_leg(mesh) -> tuple:
+        eng = DistributedEngine(mesh)
+        eng.register_table("t", stacked)
+        res = eng.execute(ctx)  # compile + correctness
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            eng.execute(ctx)
+            ts.append(time.perf_counter() - t0)
+        return round(rows / float(np.min(ts)), 1), [tuple(r) for r in res.rows]
+
+    base_rps, base_rows = scan_leg(default_mesh())
+    topologies = {"seg8": {"rows_per_sec": base_rps, "bit_identical": True}}
+    for r, s in [(1, 8), (2, 4), (4, 2)]:
+        rps, out = scan_leg(make_mesh2d(r, s))
+        same = out == base_rows
+        topologies[f"{r}x{s}"] = {"rows_per_sec": rps, "bit_identical": same}
+        assert same, f"mesh {r}x{s} drifted from the 1-D baseline"
+
+    one_dev_rps, _ = scan_leg(default_mesh(num_devices=1))
+    shard_speedup = round(topologies["1x8"]["rows_per_sec"] / one_dev_rps, 3)
+
+    def qps_leg(num_replicas: int) -> dict:
+        eng = ReplicatedEngine(num_replicas=num_replicas)
+        eng.register_table("t", stacked)
+        n_clients = int(os.environ.get("BENCH_MESH_CLIENTS", 8))
+        reqs = int(os.environ.get("BENCH_MESH_REQS", 4))
+        # warm every replica row's plan/device caches out of the timed span
+        for _ in range(num_replicas):
+            eng.execute(ctx)
+        lats = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(n_clients + 1)
+
+        def client():
+            barrier.wait()
+            for _ in range(reqs):
+                t0 = time.perf_counter()
+                eng.execute(ctx)
+                dt = (time.perf_counter() - t0) * 1000.0
+                with lock:
+                    lats.append(dt)
+
+        threads = [threading.Thread(target=client, daemon=True) for _ in range(n_clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        arr = np.asarray(lats)
+        return {
+            "replicas": num_replicas,
+            "qps": round(len(lats) / wall, 1),
+            "p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        }
+
+    qps_r1 = qps_leg(1)
+    qps_r2 = qps_leg(2)
+    replica_scale = round(qps_r2["qps"] / qps_r1["qps"], 3) if qps_r1["qps"] else None
+    return {
+        "rows": rows,
+        "topologies": topologies,
+        "single_device_rows_per_sec": one_dev_rps,
+        "mesh_shard_speedup": shard_speedup,
+        "qps_r1": qps_r1,
+        "qps_r2": qps_r2,
+        "mesh_replica_qps_scale": replica_scale,
+    }
+
+
 def _working_set_sweep() -> dict:
     """Tiered-storage capacity sweep (round-14 tentpole).
 
@@ -1093,6 +1212,7 @@ def main() -> None:
         "overload": _overload_bench(),
         "tail_latency": _tail_latency_bench(),
         "concurrent_qps": _concurrent_qps_bench(),
+        "mesh_scaling": _mesh_scaling_bench(),
         "working_set_sweep": _working_set_sweep(),
         "failover": _failover_bench(),
     }

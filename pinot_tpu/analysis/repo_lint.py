@@ -155,6 +155,20 @@ failover deterministically.  Sharper than W005: W005 only flags elapsed
 subtraction/comparison, while a lease bug's signature is the ADDITION
 (`deadline = time.time() + ttl`), which W005 deliberately ignores.
 
+W025 guards the mesh-topology abstraction (parallel/mesh.py): a collective
+(`lax.psum`/`pmin`/`pmax`/`all_gather`/`all_to_all`/`ppermute`/`axis_index`)
+called with a bare axis-name string literal ("seg"/"replica"/"shard", or a
+tuple literal of them) outside parallel/mesh.py hardcodes one mesh topology
+into the call site.  Since the 2-D (replica x shard) scale-out, the axis an
+exchange or combine runs over is decided by the mesh the engine was built
+on — 1-D legacy ("seg",), 2-D capacity (both axes), or a replica row's own
+1-D submesh — and combines must reduce hierarchically (shard/ICI first,
+then replica/DCN).  A literal traces fine on the topology it was written
+against and fails — or reduces over the wrong axis SUBSET, silently
+producing per-row partial results — on the others.  Call sites must thread
+the engine's `axis`/`axes` (or parallel/mesh constants/helpers) instead;
+mesh.py itself, which defines the names, is exempt.
+
 W023/W024 are the resource-lifecycle passes (analysis/lifecycle.py): W023
 tracks the ledger open/close pairs (reserve->release, try_charge->uncharge,
 try_fire->unfire, register->deregister, arm->disarm) and flags an opened
@@ -189,6 +203,7 @@ RULES: Dict[str, str] = {
     "W020": "packed words widened via .astype() in a Pallas kernel body before the lane unpack (shift first, then cast)",
     "W021": "synchronous jax.device_put of a segment-sized array outside the staging stream (route through the residency manager's budgeted charge)",
     "W022": "wall-clock time.time() arithmetic in lease/election/fencing code (use the injectable/monotonic clock)",
+    "W025": "bare mesh-axis string literal passed to a collective outside parallel/mesh.py (use the engine's axis/axes or the mesh module's axis constants)",
     # interprocedural passes (analysis/races.py, analysis/device_sync.py —
     # run via analysis/engine.py over the whole package, not per-file):
     "W010": "lock-guarded attribute read/written without holding its lock",
@@ -1429,6 +1444,69 @@ def _check_w021(path: str, tree: ast.AST, findings: List[Finding]) -> None:
     visit(tree, False)
 
 
+_W025_COLLECTIVES = frozenset(
+    {"psum", "pmin", "pmax", "pmean", "all_gather", "all_to_all", "ppermute", "axis_index"}
+)
+_W025_AXIS_LITERALS = frozenset({"seg", "replica", "shard"})
+
+
+def _w025_axis_literal(node: ast.AST) -> bool:
+    """A bare axis-name literal: the string itself, or a tuple/list literal
+    whose elements include one (the 2-D `("replica", "shard")` spelling)."""
+    if isinstance(node, ast.Constant) and node.value in _W025_AXIS_LITERALS:
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(
+            isinstance(e, ast.Constant) and e.value in _W025_AXIS_LITERALS for e in node.elts
+        )
+    return False
+
+
+def _check_w025(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """W025: bare mesh-axis string literals at collective call sites.
+
+    The 2-D (replica x shard) mesh made axis names a TOPOLOGY decision:
+    engines carry the mesh's actual axes (parallel/mesh.data_axes) and
+    combines must reduce hierarchically over them.  A collective called with
+    a hardcoded "seg"/"replica"/"shard" literal silently binds the call site
+    to one topology — it traces fine on the mesh it was written against and
+    fails (or, worse, reduces over the wrong axis subset) on the others.
+    parallel/mesh.py is exempt: it DEFINES the names."""
+    norm = path.replace(os.sep, "/")
+    if norm.endswith("parallel/mesh.py"):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in _W025_COLLECTIVES):
+            continue
+        # lax.psum / jax.lax.psum — anything else named psum is not a
+        # mesh collective (e.g. a method on some other object)
+        base = f.value
+        is_lax = (isinstance(base, ast.Name) and base.id == "lax") or (
+            isinstance(base, ast.Attribute)
+            and base.attr == "lax"
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "jax"
+        )
+        if not is_lax:
+            continue
+        operands = list(node.args) + [
+            kw.value for kw in node.keywords if kw.arg in ("axis_name", "axis")
+        ]
+        for arg in operands:
+            if _w025_axis_literal(arg):
+                findings.append(Finding(
+                    path, node.lineno, "W025",
+                    f"collective lax.{f.attr} called with a bare mesh-axis "
+                    "string literal — binds the call site to one mesh "
+                    "topology; thread the engine's axis/axes (or the "
+                    "parallel/mesh constants) instead",
+                ))
+                break
+
+
 def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> List[Finding]:
     """Lint one module's source.  `threaded` enables the cluster/-scoped
     rules (W004 shared-state races, W006 swallowed exceptions, W015
@@ -1460,6 +1538,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
     _check_w017(path, tree, findings)
     _check_w021(path, tree, findings)
     _check_w022(path, tree, findings)
+    _check_w025(path, tree, findings)
     if threaded:
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)

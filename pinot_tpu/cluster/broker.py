@@ -480,6 +480,11 @@ class Broker:
         self.selector = selector  # "balanced" | "replicagroup" | "adaptive"
         self._rr = 0  # round-robin cursor
         self._rr_lock = threading.Lock()  # cursor bump is an RMW across handler threads
+        # mesh-replica batch routing: whole same-fingerprint batches land on
+        # one replica row each (replica group ≅ mesh replica row), rotated
+        # per BATCH so concurrent batches spread across rows while every
+        # member of a batch shares its row's compiled kernel + staged copy
+        self._batch_rr = 0
         self.quota = QueryQuotaManager()
         self.server_stats = AdaptiveServerStats()
         self.health = ServerHealth()
@@ -582,6 +587,7 @@ class Broker:
         seg_names: List[str],
         exclude: frozenset = frozenset(),
         partial_ok: bool = False,
+        prefer_group: Optional[int] = None,
     ):
         """segment list -> {server: [segments]} picking ONE live replica per
         segment (InstanceSelector contract).
@@ -591,7 +597,11 @@ class Broker:
         OPEN) are skipped while a healthy replica exists; when a segment's
         every replica is quarantined, availability wins and they serve.
         With partial_ok, returns (assign, unroutable_segments) instead of
-        raising on a replica-less segment."""
+        raising on a replica-less segment.  `prefer_group` (replicagroup
+        selector only) starts the group rotation at that replica group —
+        the batched scatter path uses it to pin a whole batch to one mesh
+        replica row; a dead/partial preferred group still falls through the
+        rotation, so it's a preference, never an availability constraint."""
         view = self.coordinator.external_view(table)
         healthy = {
             s for s in self.coordinator.live if s not in exclude and self.health.available(s)
@@ -607,7 +617,10 @@ class Broker:
                 groups.setdefault(self.coordinator.replica_group[s], set()).add(s)
             order = sorted(groups)
             for gi in range(len(order)):
-                g = order[(rr + gi) % len(order)]
+                if prefer_group is not None:
+                    g = order[(prefer_group + gi) % len(order)]
+                else:
+                    g = order[(rr + gi) % len(order)]
                 members = groups[g]
                 assign: Dict[str, List[str]] = {}
                 ok = True
@@ -1560,7 +1573,14 @@ class Broker:
         recording it on the breaker, so the retry routes around the bad
         server."""
         n = len(group)
-        assign = self._route(table, seg_names)
+        # whole-batch replica-row pinning: every member of a same-fingerprint
+        # batch routes to ONE replica group (mesh replica row), and batches
+        # round-robin across rows — concurrent QPS scales with row count
+        # while each row serves its batch from one staged copy
+        with self._rr_lock:
+            prefer = self._batch_rr
+            self._batch_rr += 1
+        assign = self._route(table, seg_names, prefer_group=prefer)
         trace_on = any(m.trace.enabled for m in group)
         results: List[list] = [[] for _ in range(n)]
         stats = [ExecutionStats(num_segments_pruned=m.pruned) for m in group]

@@ -848,6 +848,22 @@ class Coordinator:
             out.append(pick)
         return out
 
+    def mesh_placement(self, num_replica_rows: int) -> Dict[int, List[str]]:
+        """Map the 2-D mesh's replica rows onto live servers: row r serves
+        the replica groups congruent to r (mod num_replica_rows).  A derived
+        view over replica_group/live — it tracks rebalances and failovers
+        automatically, and CoordinatorHandle makes it HA-aware like every
+        other Coordinator method.  An engine-side ReplicatedEngine consults
+        this to skip rows whose backing servers are all dead."""
+        rows = max(1, int(num_replica_rows))
+        with self._membership_lock:
+            live = set(self.live)
+            groups = dict(self.replica_group)
+        out: Dict[int, List[str]] = {r: [] for r in range(rows)}
+        for server in sorted(live):
+            out[groups.get(server, 0) % rows].append(server)
+        return out
+
     # -- views -----------------------------------------------------------
     def external_view(self, table: str) -> Dict[str, Set[str]]:
         """Ideal state filtered to LIVE servers — what the broker routes on

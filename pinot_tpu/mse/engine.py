@@ -42,6 +42,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from pinot_tpu.mse import exchange as ex
 from pinot_tpu.mse.join import KEY_SENTINEL, lookup_join, range_join
 from pinot_tpu.mse.plan import JoinPlanError, ResolvedQuery, resolve
+from pinot_tpu.parallel import mesh as mesh_mod
 from pinot_tpu.parallel.engine import (
     _psum_field,
     _ShardView,
@@ -160,21 +161,44 @@ class _MsePlan:
     # kernel cost model (utils/perf.KernelCost), captured at first dispatch
     # and shared through the plan cache (hits copy it forward)
     cost: Optional[Any] = None
+    # shuffle bucket slack this plan's kernel was TRACED with (cap_f bakes
+    # into the program, so slack is part of the plan-cache key); the
+    # overflow back-pressure loop doubles it and re-plans
+    slack: float = 2.0
+
+
+class ExchangeOverflowError(RuntimeError):
+    """A hash exchange dropped rows (bucket capacity exceeded).  Carries the
+    slack the failing plan ran with so the engine's back-pressure loop can
+    re-plan with a doubled slack (execute's retry — the TPU analog of
+    mailbox back-pressure)."""
+
+    def __init__(self, overflow: int, slack: float):
+        self.overflow = int(overflow)
+        self.slack = float(slack)
+        super().__init__(
+            f"hash exchange dropped {self.overflow} rows at shuffleSlack="
+            f"{self.slack} (bucket capacity exceeded)"
+        )
 
 
 class MultiStageEngine:
-    """Join-capable engine over StackedTables sharing one mesh."""
+    """Join-capable engine over StackedTables sharing one mesh (1-D seg or
+    2-D replica x shard — parallel/mesh.data_axes; on 2-D, exchanges span
+    the axes tuple and combines reduce hierarchically, shard/ICI first)."""
 
-    def __init__(self, mesh=None, axis: str = "seg", tables: Optional[Dict[str, Any]] = None):
+    def __init__(self, mesh=None, axis="seg", tables: Optional[Dict[str, Any]] = None):
         if mesh is None:
             from pinot_tpu.parallel.mesh import default_mesh
 
-            mesh = default_mesh(axis)
+            mesh = default_mesh(axis if isinstance(axis, str) else axis[0])
+        from pinot_tpu.parallel import mesh as mesh_mod
         from pinot_tpu.query.planner import _plan_cache_entries
         from pinot_tpu.utils.cache import LruCache
 
         self.mesh = mesh
-        self.axis = axis
+        self.axes = mesh_mod.data_axes(mesh)
+        self.axis = self.axes[0] if len(self.axes) == 1 else self.axes
         self.tables: Dict[str, Any] = tables if tables is not None else {}
         # plan-cache bytes charge the process host ledger the admission
         # controller tracks (runtime import: admission is cluster-layer)
@@ -205,36 +229,47 @@ class MultiStageEngine:
     # ------------------------------------------------------------------
     def execute(self, ctx: QueryContext) -> ResultTable:
         t0 = time.perf_counter()
-        plan = self._plan(ctx)
-        rq = plan.rq
-        fact_st = self.tables[rq.fact]
-        stats = ExecutionStats(
-            num_segments_queried=fact_st.num_shards,
-            num_segments_processed=fact_st.num_shards,
-            num_docs_scanned=fact_st.num_docs
-            + sum(self.tables[j.table].num_docs for j in rq.joins),
-            total_docs=fact_st.num_docs,
-        )
-        fact_cols, fact_valid = fact_st.to_device(self.mesh, self.axis, plan.fact_needed)
-        dim_cols, dim_valids = [], []
-        for j in rq.joins:
-            st = self.tables[j.table]
-            c, v = st.to_device(self.mesh, self.axis, plan.dim_needed[j.table])
-            dim_cols.append(c)
-            dim_valids.append(v)
-        stats.add_index_uses(plan.index_uses)
-        rep = NamedSharding(self.mesh, P())
-        row = NamedSharding(self.mesh, P(self.axis, None))
-        params = {}
-        for k, v in plan.params.items():
-            if isinstance(v, dict):
-                ns = (plan.sharded_by_ns or {}).get(k, frozenset())
-                params[k] = {
-                    k2: jax.device_put(v2, row if k2 in ns else rep) for k2, v2 in v.items()
-                }
-            else:
-                params[k] = jax.device_put(v, rep)
-        result = self._run(rq.ctx, plan, fact_cols, fact_valid, dim_cols, dim_valids, params, stats)
+        # Overflow back-pressure loop: a shuffle plan whose fixed-capacity
+        # exchange buckets dropped rows re-plans with a DOUBLED slack
+        # (bounded by _backoff_slack) and re-runs.  Results are exact after
+        # the retry — dropped rows never fold into partials because the
+        # host checks the psum'd overflow counter before consuming output.
+        slack_override: Optional[float] = None
+        while True:
+            plan = self._plan(ctx, slack=slack_override)
+            rq = plan.rq
+            fact_st = self.tables[rq.fact]
+            stats = ExecutionStats(
+                num_segments_queried=fact_st.num_shards,
+                num_segments_processed=fact_st.num_shards,
+                num_docs_scanned=fact_st.num_docs
+                + sum(self.tables[j.table].num_docs for j in rq.joins),
+                total_docs=fact_st.num_docs,
+            )
+            fact_cols, fact_valid = fact_st.to_device(self.mesh, self.axis, plan.fact_needed)
+            dim_cols, dim_valids = [], []
+            for j in rq.joins:
+                st = self.tables[j.table]
+                c, v = st.to_device(self.mesh, self.axis, plan.dim_needed[j.table])
+                dim_cols.append(c)
+                dim_valids.append(v)
+            stats.add_index_uses(plan.index_uses)
+            rep = NamedSharding(self.mesh, P())
+            row = NamedSharding(self.mesh, P(self.axis, None))
+            params = {}
+            for k, v in plan.params.items():
+                if isinstance(v, dict):
+                    ns = (plan.sharded_by_ns or {}).get(k, frozenset())
+                    params[k] = {
+                        k2: jax.device_put(v2, row if k2 in ns else rep) for k2, v2 in v.items()
+                    }
+                else:
+                    params[k] = jax.device_put(v, rep)
+            try:
+                result = self._run(rq.ctx, plan, fact_cols, fact_valid, dim_cols, dim_valids, params, stats)
+                break
+            except ExchangeOverflowError as e:
+                slack_override = self._backoff_slack(rq.ctx, e)
         out = reduce_mod.reduce_results(rq.ctx, [result], stats)
         out.stats.time_ms = (time.perf_counter() - t0) * 1000
         from pinot_tpu.query.shape import shape_digest
@@ -253,12 +288,34 @@ class MultiStageEngine:
         return out
 
     # ------------------------------------------------------------------
-    def _plan(self, ctx: QueryContext) -> _MsePlan:
+    def _backoff_slack(self, ctx: QueryContext, err: ExchangeOverflowError) -> float:
+        """Back-pressure response to a bucket overflow: double the slack,
+        bounded by shuffleSlackCap (default ndev^2 — at that slack every
+        bucket can hold the whole global row set, so a further overflow is
+        impossible and anything still failing is a bug, not skew)."""
+        ndev = self.num_devices
+        cap = float(ctx.options.get("shuffleSlackCap", float(ndev * ndev)))
+        if err.slack >= cap:
+            raise RuntimeError(
+                f"hash exchange still dropped {err.overflow} rows at "
+                f"shuffleSlack={err.slack} (cap {cap}); raise the "
+                "shuffleSlackCap query option if the key skew is expected"
+            ) from err
+        from pinot_tpu.utils.metrics import METRICS
+
+        METRICS.counter("mse.exchangeOverflowRetries").inc()
+        return min(err.slack * 2.0, cap)
+
+    def _plan(self, ctx: QueryContext, slack: Optional[float] = None) -> _MsePlan:
         from pinot_tpu.analysis.compile_audit import MSE_AUDIT
         from pinot_tpu.query.shape import column_info_from, params_structure
 
         rq = resolve(ctx, self.tables)
         strategy = self._strategy(ctx, rq)
+        if slack is None:
+            slack = float(ctx.options.get("shuffleSlack", 2.0))
+        if strategy != "shuffle":
+            slack = 0.0  # broadcast plans never bucketize: one cache entry
 
         def _info(name: str):
             # column shapes resolve through the owning table (join queries
@@ -274,13 +331,17 @@ class MultiStageEngine:
             strategy,
             self.axis,
             self.num_devices,
+            # slack bakes into the traced kernel as the bucket capacity, so
+            # a retry at doubled slack MUST miss here — reusing the old
+            # kernel would silently re-drop the same rows
+            slack,
         )
         cached = self._plan_cache.get(key)
         if cached is not None:
             # rebind literals into a fresh plan around the cached jitted
             # kernel; a params-structure mismatch means the shape audit was
             # wrong for this query — count it as the compile it would be
-            plan = self._build_plan(rq, strategy, compiled_fn=cached.fn)
+            plan = self._build_plan(rq, strategy, slack, compiled_fn=cached.fn)
             if (
                 params_structure(plan.params) == params_structure(cached.params)
                 and plan.sharded_by_ns == cached.sharded_by_ns
@@ -295,7 +356,7 @@ class MultiStageEngine:
         MSE_AUDIT.record_compile(key[0])
         self._last_plan_cache_hit = False
         self._last_shape_fp = key[0]
-        plan = self._build_plan(rq, strategy)
+        plan = self._build_plan(rq, strategy, slack)
         self._plan_cache.put(key, plan)
         return plan
 
@@ -478,7 +539,11 @@ class MultiStageEngine:
 
     # ------------------------------------------------------------------
     def _build_plan(
-        self, rq: ResolvedQuery, strategy: str, compiled_fn: Optional[Callable] = None
+        self,
+        rq: ResolvedQuery,
+        strategy: str,
+        slack: float,
+        compiled_fn: Optional[Callable] = None,
     ) -> _MsePlan:
         ctx = rq.ctx
         axis = self.axis
@@ -674,8 +739,6 @@ class MultiStageEngine:
             v = fcols[gd.name]["values"]
             return (v - np.asarray(gd.base, dtype=v.dtype)).astype(jnp.int32)
 
-        slack = float(ctx.options.get("shuffleSlack", 2.0))
-
         # bounded M:N expansion (at most one non-unique build side)
         dup_idxs = [i for i, jp in enumerate(join_plans) if jp.max_dup > 1]
         if len(dup_idxs) > 1:
@@ -867,7 +930,7 @@ class MultiStageEngine:
             presence, partials = planner_mod.grouped_partials(
                 aggs, inputs, tmask, key, num_groups, vranges
             )
-            presence = lax.psum(presence, axis)
+            presence = mesh_mod.psum_hierarchical(presence, axis)
             partials = [
                 {f: _psum_field(f, x, axis) for f, x in p.items()} for p in partials
             ]
@@ -941,6 +1004,7 @@ class MultiStageEngine:
             select_columns=select_columns,
             joins_info=[(jp.dim_table, jp.join_type) for jp in join_plans],
             dup_idx=dup_idx,
+            slack=slack,
         )
 
     # ------------------------------------------------------------------
@@ -976,10 +1040,9 @@ class MultiStageEngine:
         stats.kernel_cost_source = plan.cost.source
         overflow = int(jax.device_get(overflow))
         if overflow:
-            raise RuntimeError(
-                f"hash exchange dropped {overflow} rows (bucket capacity exceeded); "
-                "raise the shuffleSlack query option (default 2.0) and retry"
-            )
+            # execute()'s back-pressure loop catches this, doubles the slack
+            # (bounded by shuffleSlackCap) and re-plans + re-runs
+            raise ExchangeOverflowError(overflow, plan.slack)
         if plan.kind == "aggregation":
             return AggSegmentResult(partials=jax.device_get(out))
         if plan.kind == "selection":

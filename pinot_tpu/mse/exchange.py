@@ -8,26 +8,35 @@ mailboxes (GrpcSendingMailbox.java:123) with back-pressure.
 Re-design (SURVEY.md 2.6, 5.8): stage-to-stage rows never leave the device.
 An exchange is a collective inside the one compiled program:
 
-  broadcast  -> lax.all_gather over the mesh axis (BroadcastExchange): every
+  broadcast  -> lax.all_gather over the data axes (BroadcastExchange): every
                 device sees the whole (filtered) build side.
   hash       -> bucketize-by-key-hash + lax.all_to_all (HashExchange): rows
                 land on the device that owns their key partition.
 
+`axis` is one mesh axis name OR the 2-D (replica, shard) axes tuple
+(parallel/mesh.data_axes): on the 2-D capacity mesh the exchange spans both
+axes (rows shard jointly over them); on a replica row's 1-D submesh it is
+automatically shard-local — the plan passes the row's own axis and no
+exchange byte crosses the replica/DCN boundary.
+
 Static shapes: a hash exchange cannot know its per-destination row counts at
 trace time, so rows ride in fixed [ndev, capacity] buckets with a validity
-mask; rows beyond capacity are DROPPED and counted, and the host raises on a
-non-zero overflow (the caller re-runs with a bigger slack — the TPU analog of
-mailbox back-pressure, which blocks instead).
+mask; rows beyond capacity are DROPPED and counted.  On a non-zero overflow
+the engine RE-RUNS the exchange with a doubled shuffleSlack (bounded —
+mse/engine.py _run) — the TPU analog of mailbox back-pressure, which blocks
+instead.
 """
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 from jax import lax
 
+AxisSpec = Union[str, Sequence[str]]
 
-def broadcast_rows(arrays: Dict[str, jnp.ndarray], axis: str) -> Dict[str, jnp.ndarray]:
+
+def broadcast_rows(arrays: Dict[str, jnp.ndarray], axis: AxisSpec) -> Dict[str, jnp.ndarray]:
     """All devices receive every device's rows, concatenated in mesh order."""
     return {k: lax.all_gather(v, axis, tiled=True) for k, v in arrays.items()}
 
@@ -48,7 +57,7 @@ def hash_repartition(
     ok: jnp.ndarray,
     ndev: int,
     capacity: int,
-    axis: str,
+    axis: AxisSpec,
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
     """HashExchange: send each valid row to device `dest[row]`.
 
@@ -60,7 +69,7 @@ def hash_repartition(
       received_arrays[k] is [ndev * capacity, ...] — this device's partition
       of the global row set; received_valid marks real rows; overflow is the
       GLOBAL number of rows dropped for exceeding per-destination capacity
-      (psum'd — the host must raise when > 0).
+      (psum'd — the engine re-runs with a doubled slack when > 0).
     """
     n = dest.shape[0]
     d = jnp.where(ok, dest, jnp.int32(ndev))  # invalid -> out-of-range, dropped

@@ -8,9 +8,20 @@ QueryRouter.submitQuery scatter (pinot-core/.../transport/QueryRouter.java:77)
 Re-design (SURVEY.md section 7 "Combine = collective"): there is no transport.
 Segments live stacked+sharded in HBM across the mesh (stacked.py); a query
 compiles to ONE shard_map kernel that filters/aggregates its local shard rows
-and merges partials IN-GRAPH with lax.psum/pmin/pmax over the ICI axis.  The
+and merges partials IN-GRAPH with lax.psum/pmin/pmax over the data axes.  The
 host sees already-combined results; the remaining broker work (HAVING, ORDER
 BY, LIMIT, formatting) reuses query/reduce.py verbatim.
+
+The mesh may be the legacy 1-D SEG_AXIS mesh or the 2-D
+(REPLICA_AXIS, SHARD_AXIS) mesh (parallel/mesh.py).  On 2-D, table rows
+shard jointly over BOTH axes (capacity mode) and the combine is
+HIERARCHICAL: reduce over SHARD_AXIS (ICI) first — collapsing each replica
+row to one partial table — then once over REPLICA_AXIS, the only reduction
+that crosses host/DCN boundaries on a multi-host pod, so cross-host bytes
+scale with partial-table size rather than raw rows.  The QPS deployment of
+the same mesh is ReplicatedEngine below: one 1-D sub-engine per replica
+row, each a full data copy, whole same-fingerprint batches routed to rows
+round-robin.
 
 DataTable/Netty have no analog here by design: the wire format between
 "servers" (shards) is an XLA collective over ICI/DCN (SURVEY.md 2.6).
@@ -29,6 +40,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pinot_tpu import ops
+from pinot_tpu.parallel import mesh as mesh_mod
 from pinot_tpu.query import executor as sse_executor
 from pinot_tpu.query import reduce as reduce_mod
 from pinot_tpu.query import planner as planner_mod
@@ -62,13 +74,21 @@ def shard_map_compat(f, *, mesh, in_specs, out_specs):
     return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
 
-def _psum_field(name: str, x, axis: str):
+def _psum_field(name: str, x, axes):
+    """Combine one partial field across the data axes, innermost axis
+    (ICI) first — on the 2-D mesh the REPLICA_AXIS step is the only one
+    that crosses host/DCN boundaries and it moves partial-table bytes.
+    Float sums take the order-canonical path (mesh.psum_ordered): integer
+    adds and min/max are exact under any association, but float partials
+    must reduce in one fixed global order or 2x4 and 8x1 drift by ulps."""
     op = FIELD_COMBINE[name]
     if op == "add":
-        return lax.psum(x, axis)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return mesh_mod.psum_ordered(x, axes)
+        return mesh_mod.psum_hierarchical(x, axes)
     if op == "min":
-        return lax.pmin(x, axis)
-    return lax.pmax(x, axis)
+        return mesh_mod.pmin_hierarchical(x, axes)
+    return mesh_mod.pmax_hierarchical(x, axes)
 
 
 def flatten_cols(cols):
@@ -197,22 +217,27 @@ class DistributedEngine:
     def __init__(
         self,
         mesh=None,
-        axis: str = "seg",
+        axis: str = mesh_mod.SEG_AXIS,
         launch_bytes: Optional[int] = None,
         pipeline_depth: Optional[int] = None,
         hbm_cache_bytes: Optional[int] = None,
+        residency=None,
     ):
         import os
 
         if mesh is None:
-            from pinot_tpu.parallel.mesh import default_mesh
-
-            mesh = default_mesh(axis)
+            mesh = mesh_mod.default_mesh(axis)
         from pinot_tpu.query.planner import _plan_cache_entries
         from pinot_tpu.utils.cache import LruCache
 
         self.mesh = mesh
-        self.axis = axis
+        # data-placement axes, outermost first: ("seg",) on the legacy 1-D
+        # mesh, (REPLICA_AXIS, SHARD_AXIS) on the 2-D mesh.  `self.axis` is
+        # what flows into PartitionSpecs and collectives — a bare name for
+        # 1-D, the axes tuple for 2-D (both spellings every jax collective
+        # accepts); hierarchical combines walk `self.axes` innermost-first.
+        self.axes: Tuple[str, ...] = mesh_mod.data_axes(mesh)
+        self.axis = self.axes[0] if len(self.axes) == 1 else self.axes
         self.tables: Dict[str, Any] = {}  # name -> StackedTable
         # plan-cache bytes charge the process host ledger the admission
         # controller tracks (runtime import: admission is cluster-layer)
@@ -254,7 +279,11 @@ class DistributedEngine:
         # restores the legacy pin-everything path).
         from pinot_tpu.segment.residency import default_residency
 
-        if hbm_cache_bytes is not None and hbm_cache_bytes > 0:
+        if residency is not None:
+            # caller-owned manager (ReplicatedEngine splits one HBM budget
+            # into per-mesh-row managers so staging/eviction stays row-local)
+            self.residency = residency
+        elif hbm_cache_bytes is not None and hbm_cache_bytes > 0:
             from pinot_tpu.cluster.admission import ResourceBudget
 
             self.residency = default_residency(
@@ -817,7 +846,7 @@ class DistributedEngine:
                         mask_words=params[word_key].reshape(-1),
                         key_packed=_key_packed(cols),
                     )
-                    presence = lax.psum(presence, axis)
+                    presence = mesh_mod.psum_hierarchical(presence, axis)
                     partials = [
                         {f: _psum_field(f, x, axis) for f, x in p.items()} for p in partials
                     ]
@@ -837,7 +866,7 @@ class DistributedEngine:
                         aggs, inputs, tmask, key, num_groups, vranges,
                         backend=scan_be, key_packed=_key_packed(cols),
                     )
-                    presence = lax.psum(presence, axis)
+                    presence = mesh_mod.psum_hierarchical(presence, axis)
                     partials = [
                         {f: _psum_field(f, x, axis) for f, x in p.items()} for p in partials
                     ]
@@ -1335,3 +1364,122 @@ def sse_executor_needed_columns(ctx: QueryContext, stacked) -> List[str]:
 
     view = SimpleNamespace(schema=stacked.schema)
     return _needed_columns(ctx, view)
+
+
+class ReplicatedEngine:
+    """QPS deployment of the 2-D mesh: one 1-D DistributedEngine per
+    replica row, each holding a FULL copy of every registered table on its
+    own disjoint device set (replica-group serving, SURVEY.md 2.5).
+
+    Routing follows the r14 micro-batcher contract: whole same-fingerprint
+    batches go to one replica row, rows rotate round-robin — concurrent
+    load spreads across rows so sustained QPS scales with R while each
+    row's plan/device caches stay hot (a per-query spray would cold-start
+    every row's cache on every shape).
+
+    Placement is CoordinatorHandle-driven when a coordinator is attached:
+    `mesh_placement(R)` maps journaled replica groups onto mesh rows, so
+    rebalance and leader failover move the routing view and the mesh
+    placement together — a row whose replica group has no live server is
+    skipped by the round-robin until it recovers.
+
+    Each row gets its OWN residency manager with an even share of the HBM
+    cache budget (segment/residency.row_residency): staging and eviction
+    are row-local, so one row's working set never evicts another's."""
+
+    def __init__(
+        self,
+        mesh=None,
+        num_replicas: int = 2,
+        hbm_cache_bytes: Optional[int] = None,
+        coordinator=None,
+        **engine_kwargs,
+    ):
+        import threading
+
+        if mesh is None:
+            mesh = mesh_mod.make_mesh2d(num_replicas)
+        self.mesh = mesh
+        rows = mesh_mod.replica_rows(mesh)
+        from pinot_tpu.segment.residency import row_residency
+
+        self.engines: List[DistributedEngine] = []
+        for r, row_mesh in enumerate(rows):
+            res = row_residency(len(rows), r, total_bytes=hbm_cache_bytes)
+            self.engines.append(
+                DistributedEngine(
+                    row_mesh,
+                    axis=row_mesh.axis_names[0],
+                    residency=res,
+                    hbm_cache_bytes=0 if res is None else None,
+                    **engine_kwargs,
+                )
+            )
+        self.coordinator = coordinator
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        # One dispatch lock per row: a row's collectives must never
+        # interleave with another in-flight program on the SAME device set
+        # (XLA's CPU collective rendezvous deadlocks when two programs'
+        # participants mix).  Concurrency comes from having R rows — each
+        # row is one serving pipeline — not from racing a row's mesh.
+        self._row_locks = [threading.Lock() for _ in self.engines]
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.engines)
+
+    def register_table(self, name: str, stacked) -> None:
+        for eng in self.engines:
+            eng.register_table(name, stacked)
+
+    def _live_rows(self) -> List[int]:
+        """Rows eligible for routing: all of them standalone; with a
+        coordinator attached, only rows whose mapped replica group still
+        has a live server (failover parks a dead row out of the rotation
+        exactly as the broker's routing view drops its servers)."""
+        all_rows = list(range(len(self.engines)))
+        if self.coordinator is None:
+            return all_rows
+        placement = self.coordinator.mesh_placement(len(self.engines))
+        live = [r for r in all_rows if placement.get(r)]
+        return live or all_rows
+
+    def _next_row(self) -> int:
+        rows = self._live_rows()
+        with self._rr_lock:
+            self._rr += 1
+            return rows[self._rr % len(rows)]
+
+    def query(self, sql: str) -> ResultTable:
+        row = self._next_row()
+        with self._row_locks[row]:
+            return self.engines[row].query(sql)
+
+    def execute(self, ctx: QueryContext) -> ResultTable:
+        row = self._next_row()
+        with self._row_locks[row]:
+            return self.engines[row].execute(ctx)
+
+    def execute_many(self, ctxs: List[QueryContext]) -> List[ResultTable]:
+        """Batch routing: members group by shape fingerprint and every
+        group lands WHOLE on one replica row (vmapped same-shape launches
+        never split across rows), rows rotating per group."""
+        from pinot_tpu.query.shape import column_info_from, shape_digest
+
+        results: List[Optional[ResultTable]] = [None] * len(ctxs)
+        groups: Dict[Any, List[int]] = {}
+        for i, ctx in enumerate(ctxs):
+            stacked = self.engines[0].tables.get(ctx.table)
+            if ctx.joins or ctx.set_ops or stacked is None:
+                results[i] = self.execute(ctx)
+                continue
+            key = (ctx.table, shape_digest(ctx.shape_fingerprint(column_info_from(stacked))))
+            groups.setdefault(key, []).append(i)
+        for idxs in groups.values():
+            row = self._next_row()
+            with self._row_locks[row]:
+                outs = self.engines[row].execute_many([ctxs[i] for i in idxs])
+            for i, out in zip(idxs, outs):
+                results[i] = out
+        return results

@@ -506,7 +506,7 @@ class StackedTable:
     def to_device(
         self,
         mesh=None,
-        axis: str = "seg",
+        axis="seg",
         columns: Optional[List[str]] = None,
         doc_slice: Optional[Tuple[int, int]] = None,
         with_valid: bool = True,
@@ -539,6 +539,9 @@ class StackedTable:
             from pinot_tpu.parallel.mesh import default_mesh
 
             mesh = default_mesh(axis)
+        # `axis` may be one mesh axis name or the 2-D (replica, shard)
+        # axes tuple: a tuple shards the leading [S, ...] dim jointly over
+        # both axes (capacity mode — parallel/mesh.data_axes)
         row_sharding = NamedSharding(mesh, P(axis, None))
         rep_sharding = NamedSharding(mesh, P())
         cols = columns or list(self.columns)

@@ -388,3 +388,33 @@ def default_residency(budget=None, name: str = "residency"):
             return None
         budget = ResourceBudget(nbytes, gauge=f"{name}.reservedBytes")
     return ResidencyManager(budget, name=name, ledger=perf.PERF_LEDGER)
+
+
+def row_residency(num_rows: int, row: int, total_bytes=None, name: str = "residency"):
+    """Per-mesh-row residency manager: one replica row's even share of the
+    HBM cache budget (parallel/engine.ReplicatedEngine).
+
+    A replica axis multiplies QPS only if staging and eviction stay
+    row-local: each row holds its own full data copy on its own device set,
+    charged against its OWN budget/ledger, so one hot row's working set can
+    never evict another row's resident slices.  total_bytes defaults to
+    PINOT_TPU_HBM_CACHE_BYTES (the whole-mesh cache size); 0 disables
+    tiering for every row, like default_residency."""
+    import os
+
+    from pinot_tpu.utils import perf
+
+    if total_bytes is None:
+        from pinot_tpu.cluster.admission import default_server_hbm_budget
+
+        total_bytes = int(
+            os.environ.get("PINOT_TPU_HBM_CACHE_BYTES", str(default_server_hbm_budget()))
+        )
+    share = int(total_bytes) // max(1, int(num_rows))
+    if share <= 0:
+        return None
+    from pinot_tpu.cluster.admission import ResourceBudget
+
+    row_name = f"{name}.row{row}"
+    budget = ResourceBudget(share, gauge=f"{row_name}.reservedBytes")
+    return ResidencyManager(budget, name=row_name, ledger=perf.PERF_LEDGER)

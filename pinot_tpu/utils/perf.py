@@ -419,6 +419,14 @@ GATE_METRICS: Tuple[str, ...] = (
     "ws_sweep_1x_rows_per_sec",
     "ws_sweep_4x_rows_per_sec",
     "ws_prefetch_hit_rate",
+    # 2-D mesh scale-out (bench.py mesh_scaling): shard-axis capacity ratio
+    # (full shard width vs one device) and replica-axis concurrent-QPS ratio
+    # (ReplicatedEngine R=2 vs R=1).  In-image both hover near 1.0 (emulated
+    # devices share the container's cores) — gated as regression canaries
+    # for the hierarchical-combine and replica-routing paths, not as
+    # scaling claims
+    "mesh_shard_speedup",
+    "mesh_replica_qps_scale",
 )
 
 # Lower-is-better latency series: the gate fails when these RISE past the
@@ -449,6 +457,7 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
     agg_b = report.get("agg_bound", {}) or {}
     ws = report.get("working_set_sweep", {}) or {}
     fo = report.get("failover", {}) or {}
+    ms = report.get("mesh_scaling", {}) or {}
     return {
         "schema": 1,
         "bench": bench,
@@ -486,6 +495,11 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
             "failover_replay_ms": fo.get("replay_to_tip_ms"),
             "failover_data_plane_success_rate": (fo.get("data_plane", {}) or {}).get(
                 "success_rate"
+            ),
+            "mesh_shard_speedup": ms.get("mesh_shard_speedup"),
+            "mesh_replica_qps_scale": ms.get("mesh_replica_qps_scale"),
+            "mesh_2x4_rows_per_sec": ((ms.get("topologies", {}) or {}).get("2x4", {}) or {}).get(
+                "rows_per_sec"
             ),
         },
         "noise": {"run_variance": report.get("run_variance", 0.0)},

@@ -366,12 +366,37 @@ def test_left_join_nullable_dim_attr_null_group():
     assert got == exp
 
 
-def test_shuffle_overflow_raises(engines):
-    """Tiny slack forces bucket overflow -> clear error, not silent drops."""
+def test_shuffle_overflow_retries_to_exact_result(engines):
+    """Tiny slack forces bucket overflow -> the engine's back-pressure loop
+    re-plans with a doubled slack until the exchange fits, and the final
+    result is EXACT (no silently dropped rows fold into the partials)."""
+    from pinot_tpu.utils.metrics import METRICS
+
+    eng, lineorder, dates = engines
+    before = METRICS.counter("mse.exchangeOverflowRetries").value
+    res = eng.query(
+        "SET joinStrategy = 'shuffle'; SET shuffleSlack = 0.01; "
+        "SELECT d_year, SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey GROUP BY d_year ORDER BY d_year LIMIT 100"
+    )
+    assert METRICS.counter("mse.exchangeOverflowRetries").value > before
+    exp = sqlite_rows(
+        lineorder, dates,
+        "SELECT d_year, SUM(lo_revenue) FROM lineorder "
+        "JOIN dates ON lo_orderdate = d_datekey GROUP BY d_year ORDER BY d_year",
+    )
+    got = [(int(r[0]), int(r[1])) for r in res.rows]
+    assert got == [(int(a), int(b)) for a, b in exp]
+
+
+def test_shuffle_overflow_gives_up_at_slack_cap(engines):
+    """With the cap pinned at the starting slack the loop cannot back off ->
+    clear give-up error naming the cap, not an infinite retry loop."""
     eng, _, _ = engines
-    with pytest.raises(RuntimeError, match="shuffleSlack"):
+    with pytest.raises(RuntimeError, match="shuffleSlackCap"):
         eng.query(
             "SET joinStrategy = 'shuffle'; SET shuffleSlack = 0.01; "
+            "SET shuffleSlackCap = 0.01; "
             "SELECT d_year, SUM(lo_revenue) FROM lineorder "
             "JOIN dates ON lo_orderdate = d_datekey GROUP BY d_year"
         )

@@ -1014,6 +1014,88 @@ class TestW022WallClockInLeaseCode:
         assert _rules(src) == []
 
 
+class TestW025BareAxisLiteralInCollective:
+    def test_flags_string_literal_axis_in_psum(self):
+        src = """
+        from jax import lax
+
+        def combine(x):
+            return lax.psum(x, "seg")
+        """
+        assert _rules(src) == ["W025"]
+
+    def test_flags_tuple_literal_axes_in_all_gather(self):
+        src = """
+        from jax import lax
+
+        def fetch(v):
+            return lax.all_gather(v, ("replica", "shard"), tiled=True)
+        """
+        assert _rules(src) == ["W025"]
+
+    def test_flags_axis_name_keyword_on_jax_lax_call(self):
+        src = """
+        import jax
+
+        def exchange(buf):
+            return jax.lax.all_to_all(
+                buf, axis_name="shard", split_axis=0, concat_axis=0
+            )
+        """
+        assert _rules(src) == ["W025"]
+
+    def test_flags_axis_index_literal(self):
+        src = """
+        from jax import lax
+
+        def my_device():
+            return lax.axis_index("replica")
+        """
+        assert _rules(src) == ["W025"]
+
+    def test_quiet_on_threaded_axis_variable(self):
+        src = """
+        from jax import lax
+
+        def combine(x, axis):
+            return lax.psum(x, axis)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_mesh_module_constants(self):
+        src = """
+        from jax import lax
+        from pinot_tpu.parallel import mesh as mesh_mod
+
+        def combine(x):
+            return lax.psum(x, mesh_mod.SEG_AXIS)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_non_axis_string_and_non_collective_calls(self):
+        # a cache-group key tuple containing "seg" is NOT a collective arg
+        # (segment/segment.py keys caches this way) and psum on some other
+        # object is not a mesh collective
+        src = """
+        def key_for(self, device):
+            return ("seg", id(self), device)
+
+        def reduce_with(engine, x):
+            return engine.psum(x, "seg")
+        """
+        assert _rules(src) == []
+
+    def test_exempt_inside_parallel_mesh(self):
+        src = """
+        from jax import lax
+
+        def psum_hierarchical(x):
+            return lax.psum(x, "shard")
+        """
+        out = lint_source(textwrap.dedent(src), path="pinot_tpu/parallel/mesh.py")
+        assert out == []
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"

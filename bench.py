@@ -828,6 +828,309 @@ def _failover_bench() -> dict:
     }
 
 
+def _autopilot_overload_bench() -> dict:
+    """Closed-loop autopilot vs a grid of static knob settings (ISSUE 18):
+    mixed-tenant load (scans + group-bys on `hot`, funnels on `events`)
+    offered at 3x estimated capacity by paced client threads, with one of
+    two replicas carrying a seeded latency jitter (the r15 gray-fault
+    model).  Every leg runs the same admission ceiling and the same fault;
+    only the knob settings differ — static legs pin KnobRegistry overrides
+    up front, the autopilot leg starts at env defaults and lets the
+    controller move one knob per tick.  Reports admitted p99 per leg,
+    `autopilot_admitted_p99_ms` (lower-is-better in the `cli perf --check`
+    gate), `autopilot_vs_best_static`, and the knob-change count against
+    the controller's own oscillation bound."""
+    import threading
+
+    from pinot_tpu.cluster.admission import (
+        AdmissionController,
+        QueryKilledError,
+        ReservationError,
+        TooManyRequestsError,
+        estimate_query_cost,
+    )
+    from pinot_tpu.cluster import autopilot as ap_mod
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.coordinator import Coordinator
+    from pinot_tpu.cluster.faults import FaultPlan
+    from pinot_tpu.cluster.server import ServerInstance
+    from pinot_tpu.segment.builder import build_segment
+    from pinot_tpu.spi.config import SegmentsConfig, TableConfig
+    from pinot_tpu.spi.schema import DataType, FieldRole, FieldSpec, Schema
+    from pinot_tpu.sql.parser import parse_query
+    from pinot_tpu.utils import perf
+
+    rows = int(os.environ.get("BENCH_AUTOPILOT_ROWS", 5_000))
+    n_clients = int(os.environ.get("BENCH_AUTOPILOT_CLIENTS", 12))
+    # two-phase legs: an unmeasured warm-up (the closed loop converges, the
+    # static legs burn the identical schedule) then the measured window
+    reqs_warm = int(os.environ.get("BENCH_AUTOPILOT_WARM_REQS", 48))
+    # at 3x overload most offered requests shed, so the admitted-p99 order
+    # statistic needs a wide measured window to settle (legs are seconds each)
+    reqs_meas = int(os.environ.get("BENCH_AUTOPILOT_REQS", 160))
+    reqs = reqs_warm + reqs_meas
+    overload_x = 3.0
+
+    rng = np.random.default_rng(11)
+    coord = Coordinator(replication=2)
+    for i in range(2):
+        coord.register_server(ServerInstance(f"server{i}"))
+    hot = Schema(
+        "hot",
+        [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, role=FieldRole.METRIC),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+    coord.add_table(hot, TableConfig(name="hot", segments=SegmentsConfig(time_column="ts")))
+    events = Schema(
+        "events",
+        [
+            FieldSpec("uid", DataType.LONG),
+            FieldSpec("url", DataType.STRING),
+            FieldSpec("ts", DataType.TIMESTAMP, role=FieldRole.DATE_TIME),
+        ],
+    )
+    coord.add_table(
+        events, TableConfig(name="events", segments=SegmentsConfig(time_column="ts"))
+    )
+    for i in range(4):
+        coord.add_segment(
+            "hot",
+            build_segment(
+                hot,
+                {
+                    "city": rng.choice(["sf", "nyc", "la"], rows).astype(object),
+                    "v": rng.integers(0, 100, rows),
+                    "ts": 1_700_000_000_000 + rng.integers(0, 86_400_000, rows).astype(np.int64),
+                },
+                f"hot{i}",
+            ),
+        )
+        coord.add_segment(
+            "events",
+            build_segment(
+                events,
+                {
+                    "uid": rng.integers(0, 300, rows).astype(np.int64),
+                    "url": rng.choice(["/home", "/product", "/cart"], rows).astype(object),
+                    "ts": 1_700_000_000_000 + rng.integers(0, 86_400_000, rows).astype(np.int64),
+                },
+                f"ev{i}",
+            ),
+        )
+    broker = Broker(coord)
+    broker.health.brownout_factor = float("inf")  # isolate knobs from routing-away
+
+    shapes = [
+        lambda i: (
+            "SELECT city, COUNT(*), SUM(v) FROM hot "
+            f"WHERE v < {50 + i % 40} GROUP BY city ORDER BY city"
+        ),
+        lambda i: f"SELECT COUNT(*), MAX(v) FROM hot WHERE v > {i % 40}",
+        lambda i: (
+            "SELECT FUNNELCOUNT(STEPS(url = '/home', url = '/cart'), "
+            f"CORRELATEBY(uid)) FROM events WHERE uid >= {i % 20}"
+        ),
+    ]
+
+    def sql_at(i: int) -> str:
+        return shapes[i % len(shapes)](i)
+
+    for i in range(12):  # warm every shape: parse, plan, compile, hedge windows
+        broker.query(sql_at(i))
+
+    # ---- capacity + gray fault calibration ----------------------------
+    cal = []
+    for i in range(30):
+        t0 = time.perf_counter()
+        broker.query(sql_at(i))
+        cal.append((time.perf_counter() - t0) * 1000)
+    med_ms = float(np.median(cal))
+    capacity_qps = 1000.0 / med_ms
+    slow_ms = round(4.0 * max(0.5, med_ms), 3)
+    FaultPlan(seed=17).jitter("server0", base_ms=slow_ms, sigma=0.3).attach(coord)
+
+    unit_cost = estimate_query_cost(
+        parse_query(shapes[0](0)), coord.tables["hot"].segment_meta.values()
+    ).units
+    rate_units = capacity_qps * unit_cost
+    # the static env ceilings every leg (and the registry clamps) run under:
+    # hedging on with a fat budget, admission refill at estimated capacity
+    env_ceilings = {
+        "PINOT_TPU_HEDGE_BUDGET_PCT": "60",
+        "PINOT_TPU_ADMISSION_RATE": f"{rate_units:.4f}",
+    }
+    saved_env = {k: os.environ.get(k) for k in env_ceilings}
+    os.environ.update(env_ceilings)
+    broker.hedge.enabled_default = True
+    # achievable target under the fault model: one un-hedged scatter leg
+    # rides the slow replica, so the admitted tail floors near 2x its
+    # jitter base — an SLO below that saturates the ladder instead of
+    # letting the loop settle on the cheapest config that meets it
+    slo_ms = round(2.0 * slow_ms, 3)
+    interval_s = n_clients / (overload_x * capacity_qps)  # per-client pacing
+
+    def run_leg(overrides) -> dict:
+        ap_mod.reset_knobs()
+        if overrides:
+            ap_mod.knobs().set_many(overrides, who="static-config")
+        perf.PERF_LEDGER.reset()
+        adm = AdmissionController(
+            rate_units_per_s=rate_units,
+            burst_units=2 * unit_cost,
+            max_queue=0,
+            knob="admission_rate",
+        )
+        broker.governor.admission = adm
+        pilot = None
+        if overrides is None:  # the closed-loop leg
+            # 0.1 s tick: fast enough to converge well inside the warm-up
+            # phase, slow enough that the controller's own ledger snapshots
+            # don't tax the saturated host during the measured window
+            pilot = ap_mod.Autopilot(
+                governor=broker.governor, slo_ms=slo_ms, tick_s=0.1
+            )
+            pilot.start()
+        lats, lock = [], threading.Lock()
+        counts = {"admitted": 0, "shed": 0, "killed": 0}
+        barrier = threading.Barrier(n_clients + 1)
+
+        def client(cid):
+            barrier.wait()
+            for r in range(reqs):
+                time.sleep(interval_s)
+                measured = r >= reqs_warm
+                t0 = time.perf_counter()
+                try:
+                    broker.query(sql_at(cid * reqs + r))
+                except TooManyRequestsError:
+                    if measured:
+                        with lock:
+                            counts["shed"] += 1
+                except (QueryKilledError, ReservationError):
+                    if measured:
+                        with lock:
+                            counts["killed"] += 1
+                else:
+                    if measured:
+                        with lock:
+                            counts["admitted"] += 1
+                            lats.append((time.perf_counter() - t0) * 1000)
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        for t in threads:
+            t.join()
+        broker.hedge_drain()
+        leg = {
+            **counts,
+            "admitted_p99_ms": (
+                round(float(np.percentile(lats, 99)), 3) if lats else None
+            ),
+            "admitted_p50_ms": (
+                round(float(np.percentile(lats, 50)), 3) if lats else None
+            ),
+        }
+        if pilot is not None:
+            pilot.stop()
+            snap = pilot.snapshot()
+            moves = [
+                d for d in snap["decisions"] if d["action"] in ("degrade", "recover")
+            ]
+            win, cap = snap["changeBound"]["windowTicks"], snap["changeBound"]["maxChanges"]
+            worst = 0
+            ticks = [d["tick"] for d in moves]
+            for t in ticks:
+                worst = max(worst, len([m for m in ticks if t - win < m <= t]))
+            assert worst <= cap, f"oscillation bound violated: {worst} moves/{win} ticks"
+            leg["knob_changes"] = snap["knobChanges"]
+            leg["ladder_walks"] = snap["ladderWalks"]
+            leg["max_changes_per_window"] = worst
+            leg["change_bound"] = cap
+            leg["final_knobs"] = {
+                n: k["value"]
+                for n, k in snap["knobs"].items()
+                if k["overridden"]
+            }
+        return leg
+
+    # admitted-p99 under a shed-heavy window is a tail order statistic riding
+    # on the seeded jitter's random draw — gate the median repeat, not one
+    # draw. Repeats are interleaved round-robin across configs (not config by
+    # config) so slow host drift over the section lands on every config
+    # equally instead of taxing whichever leg happens to run last.
+    n_rep = int(os.environ.get("BENCH_AUTOPILOT_REPEATS", 3))
+
+    def median_leg(runs) -> dict:
+        runs = sorted(runs, key=lambda leg: leg["admitted_p99_ms"] or float("inf"))
+        med = runs[len(runs) // 2]
+        med["admitted_p99_ms_runs"] = [r["admitted_p99_ms"] for r in runs]
+        return med
+
+    try:
+        static_grid = {
+            "default": {},  # env ceilings as-is: hedge 60%, full refill rate
+            "no_hedge": {"hedge_budget_pct": 0.0},
+            "half_rate": {"admission_rate": 0.5 * rate_units},
+            # the degradation ladder's floor: if the closed loop saturates,
+            # this is its static twin — the grid always contains whatever
+            # config the controller converges to
+            "floor": {
+                "hedge_budget_pct": 0.0,
+                "batch_wait_ms": 8.0,
+                "pipeline_depth": 1,
+                "staging_depth": 1,
+                "admission_rate": 0.25 * rate_units,
+                "degrade_level": 3,
+            },
+        }
+        order = list(static_grid.items()) + [("autopilot", None)]
+        rep_runs = {name: [] for name, _ in order}
+        for _ in range(n_rep):
+            for name, ov in order:
+                rep_runs[name].append(run_leg(ov))
+        statics = {name: median_leg(rep_runs[name]) for name in static_grid}
+        pilot_leg = median_leg(rep_runs["autopilot"])
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        ap_mod.reset_knobs()
+        broker.governor.admission = AdmissionController()  # back to permissive
+
+    best_name, best = min(
+        statics.items(), key=lambda kv: kv[1]["admitted_p99_ms"] or float("inf")
+    )
+    vs_best = (
+        round(pilot_leg["admitted_p99_ms"] / best["admitted_p99_ms"], 3)
+        if pilot_leg["admitted_p99_ms"] and best["admitted_p99_ms"]
+        else None
+    )
+    return {
+        "capacity_qps_est": round(capacity_qps, 1),
+        "offered_x": overload_x,
+        "slow_replica_ms": slow_ms,
+        "slo_ms": slo_ms,
+        "clients": n_clients,
+        "warmup_requests_per_client": reqs_warm,
+        "measured_requests_per_client": reqs_meas,
+        "repeats": n_rep,
+        "static": statics,
+        "best_static": best_name,
+        "autopilot": pilot_leg,
+        "autopilot_vs_best_static": vs_best,
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -1215,6 +1518,7 @@ def main() -> None:
         "mesh_scaling": _mesh_scaling_bench(),
         "working_set_sweep": _working_set_sweep(),
         "failover": _failover_bench(),
+        "autopilot_overload": _autopilot_overload_bench(),
     }
     print(json.dumps(report))
 

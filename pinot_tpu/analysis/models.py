@@ -19,6 +19,10 @@ declares the protocol's correctness argument as executable invariants:
   lease — at most one epoch appends to the journal at a time: epochs in
       the journal never decrease, and a deposed writer is always fenced
       before its stale append lands.
+  knobs — the autopilot KnobRegistry's one-tick-one-swap contract: a
+      query reading `view()` concurrently with a controller `set_many`
+      tick sees either the whole tick or none of it, never a mid-tick
+      mix of old and new knob values.
 
 Every model also ships MUTATIONS: deliberately broken twins (the bug the
 invariant exists to catch, reintroduced surgically).  `check_all(...,
@@ -551,9 +555,99 @@ class LeaseModel(BaseModel):
         return [("journal-fenced-at-rest", fence_observed)]
 
 
+# ---------------------------------------------------------------------------
+# knobs: a controller tick publishes atomically; queries never see a mix
+# ---------------------------------------------------------------------------
+class KnobModel(BaseModel):
+    name = "knobs"
+    MUTATIONS = ("torn_knob_write",)
+
+    # one controller "tick" always writes these two knobs to the SAME value
+    # (both clamp ranges admit it), so any reader observing them unequal —
+    # other than the env-default initial pair — caught a mid-tick mix
+    PAIR = ("batch_wait_ms", "hedge_budget_pct")
+    TICKS = (3.0, 5.0, 7.0)
+
+    def setup(self) -> None:
+        from pinot_tpu.cluster.autopilot import KnobRegistry
+
+        cls = KnobRegistry
+        if self.mutation == "torn_knob_write":
+            cls = _make_torn_registry()
+        self.reg = cls()
+        a, b = self.PAIR
+        # lock-free spec reads: setup runs on the harness thread, where the
+        # deterministic provider's lock may not be acquired
+        self.initial = (self.reg.initial(a), self.reg.initial(b))
+        self.torn: List[str] = []
+
+    def _controller(self) -> None:
+        a, b = self.PAIR
+        for v in self.TICKS:
+            threads.checkpoint()
+            self.reg.set_many({a: v, b: v}, who="mc-tick")
+
+    def _query(self) -> None:
+        a, b = self.PAIR
+        for _ in range(4):
+            threads.checkpoint()
+            view = self.reg.view()
+            got = (view[a], view[b])
+            if got != self.initial and got[0] != got[1]:
+                self.torn.append(f"{a}={got[0]} with {b}={got[1]}")
+
+    def threads(self) -> List[Tuple[str, Callable[[], None]]]:
+        return [
+            ("controller", self._controller),
+            ("query-1", self._query),
+            ("query-2", self._query),
+        ]
+
+    def invariants(self) -> List[Tuple[str, Callable[[], Optional[str]]]]:
+        def coherent_snapshot() -> Optional[str]:
+            if self.torn:
+                return f"query observed a mid-tick knob mix: {self.torn[0]}"
+            return None
+
+        return [("coherent-knob-snapshot", coherent_snapshot)]
+
+    def at_quiescence(self) -> List[Tuple[str, Callable[[], Optional[str]]]]:
+        def final_tick_applied() -> Optional[str]:
+            a, b = self.PAIR
+            last = self.TICKS[-1]
+            # raw read: quiescence callbacks run on the harness thread with
+            # every model thread parked  # pinot-lint: disable=W010
+            ov = self.reg._overrides
+            if (ov.get(a), ov.get(b)) != (last, last):
+                return (
+                    f"final tick lost: {a}={ov.get(a)} {b}={ov.get(b)}, "
+                    f"wanted both {last}"
+                )
+            return None
+
+        return [("last-tick-fully-applied", final_tick_applied)]
+
+
+def _make_torn_registry() -> type:
+    from pinot_tpu.cluster.autopilot import KnobRegistry
+
+    class TornKnobRegistry(KnobRegistry):
+        def set_many(self, updates, who="manual"):
+            # MUTATION: knobs land one swap at a time with a visible window
+            # between them — a concurrent view() reads half the tick
+            out = {}
+            for n, v in updates.items():
+                out.update(super().set_many({n: v}, who=who))
+                threads.checkpoint()
+            return out
+
+    return TornKnobRegistry
+
+
 PROTOCOLS: Dict[str, type] = {
     ResidencyModel.name: ResidencyModel,
     AdmissionModel.name: AdmissionModel,
     BatcherModel.name: BatcherModel,
     LeaseModel.name: LeaseModel,
+    KnobModel.name: KnobModel,
 }

@@ -204,6 +204,7 @@ RULES: Dict[str, str] = {
     "W021": "synchronous jax.device_put of a segment-sized array outside the staging stream (route through the residency manager's budgeted charge)",
     "W022": "wall-clock time.time() arithmetic in lease/election/fencing code (use the injectable/monotonic clock)",
     "W025": "bare mesh-axis string literal passed to a collective outside parallel/mesh.py (use the engine's axis/axes or the mesh module's axis constants)",
+    "W026": "controller discipline: direct write to a registry-managed serving knob outside a clamped KnobRegistry setter, or wall-clock use inside the autopilot (use the injected clock)",
     # interprocedural passes (analysis/races.py, analysis/device_sync.py —
     # run via analysis/engine.py over the whole package, not per-file):
     "W010": "lock-guarded attribute read/written without holding its lock",
@@ -738,6 +739,96 @@ def _check_w022(path: str, tree: ast.AST, findings: List[Finding]) -> None:
 
     scan(getattr(tree, "body", []), False)
     collect(tree, False)
+
+
+# registry-managed serving knob attributes (cluster/autopilot.py SPECS):
+# runtime mutation must go through a clamped KnobRegistry setter, never a
+# bare attribute write that skips the clamp bounds and the atomic swap
+_W026_KNOB_ATTRS = frozenset(
+    {"wait_ms", "pipeline_depth", "staging_depth", "budget_pct", "quantile_mult"}
+)
+# wall clocks forbidden inside the autopilot: the controller's whole test
+# story rides the injected clock (threads.monotonic or a ctor fake)
+_W026_WALL_CLOCKS = frozenset({"time", "monotonic", "perf_counter"})
+
+
+def _is_wall_clock_call(node: ast.AST) -> bool:
+    """`time.time()` / `time.monotonic()` / `time.perf_counter()` — module
+    attribute calls only, so `threads.monotonic()` (the injection seam)
+    and `self.clock()` stay clean."""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _W026_WALL_CLOCKS
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "time"
+    )
+
+
+def _check_w026(path: str, tree: ast.AST, findings: List[Finding]) -> None:
+    """W026 (controller discipline), two triggers:
+
+      * an Assign/AugAssign whose target is a `<obj>.<knob>` attribute for
+        a registry-managed knob name, outside `__init__` (construction
+        wires defaults) and outside a property-setter body (the sanctioned
+        pin-the-override path) — runtime knob mutation must go through a
+        clamped KnobRegistry setter so the static ceilings and the atomic
+        snapshot discipline hold;
+      * in an autopilot module (path contains "autopilot"), any
+        `time.time()`/`time.monotonic()`/`time.perf_counter()` call — the
+        control loop must read the INJECTED clock (`threads.monotonic` or
+        the ctor's fake) or the deterministic scheduler cannot drive it."""
+
+    def is_exempt_fn(fn: ast.AST) -> bool:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if fn.name == "__init__":
+            return True
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Attribute) and dec.attr == "setter":
+                return True
+        return False
+
+    def scan_writes(body: List[ast.stmt]) -> None:
+        stack: List[ast.AST] = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not is_exempt_fn(n):
+                    scan_writes(n.body)
+                continue
+            targets: List[ast.AST] = []
+            if isinstance(n, ast.Assign):
+                targets = list(n.targets)
+            elif isinstance(n, ast.AugAssign):
+                targets = [n.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr in _W026_KNOB_ATTRS:
+                    findings.append(
+                        Finding(
+                            path, n.lineno, "W026",
+                            f"direct write to registry-managed knob `.{t.attr}` "
+                            "outside a clamped KnobRegistry setter — route runtime "
+                            "tuning through autopilot.knobs().set() so clamp bounds "
+                            "and the atomic knob snapshot hold",
+                        )
+                    )
+            stack.extend(ast.iter_child_nodes(n))
+
+    scan_writes(getattr(tree, "body", []))
+
+    if "autopilot" in os.path.basename(path):
+        for n in ast.walk(tree):
+            if _is_wall_clock_call(n):
+                findings.append(
+                    Finding(
+                        path, n.lineno, "W026",
+                        f"wall-clock time.{n.func.attr}() inside the autopilot — "
+                        "the control loop must use its injected clock "
+                        "(threads.monotonic / the ctor's fake) so the "
+                        "deterministic scheduler and fake-clock tests can drive it",
+                    )
+                )
 
 
 def _check_w006(path: str, tree: ast.AST, findings: List[Finding]) -> None:
@@ -1539,6 +1630,7 @@ def lint_source(src: str, path: str = "<string>", threaded: bool = False) -> Lis
     _check_w021(path, tree, findings)
     _check_w022(path, tree, findings)
     _check_w025(path, tree, findings)
+    _check_w026(path, tree, findings)
     if threaded:
         _check_w004(path, tree, findings)
         _check_w006(path, tree, findings)

@@ -145,8 +145,14 @@ class AdmissionController:
         burst_units: Optional[float] = None,
         max_queue: int = 8,
         max_wait_ms: float = 500.0,
+        knob: Optional[str] = None,
     ):
         self.rate = float(rate_units_per_s)
+        # when `knob` names an autopilot KnobRegistry entry (the governor
+        # passes "admission_rate"), the refill rate is read from the
+        # registry per decision — a controller write takes effect on the
+        # next refill without rebuilding; burst/queue stay static ceilings
+        self.knob = knob
         self.burst = float(burst_units) if burst_units is not None else max(1.0, self.rate)
         self.max_queue = int(max_queue)
         self.max_wait_ms = float(max_wait_ms)
@@ -159,10 +165,22 @@ class AdmissionController:
         self._last_refill: Optional[float] = None
         self._waiting = 0
 
+    def _rate_now(self) -> float:
+        """Effective refill rate for THIS decision: the KnobRegistry value
+        when knob-managed (clamped to the static env ceiling by the
+        registry), else the construction-time rate."""
+        if self.knob is None:
+            return self.rate
+        from pinot_tpu.cluster import autopilot
+
+        return float(autopilot.knobs().get(self.knob))
+
     def _refill_locked(self, now: float) -> None:
         if self._last_refill is None:
             self._last_refill = now
-        self._tokens = min(self.burst, self._tokens + self.rate * (now - self._last_refill))
+        self._tokens = min(
+            self.burst, self._tokens + self._rate_now() * (now - self._last_refill)
+        )
         self._last_refill = now
 
     def tokens(self) -> float:
@@ -173,7 +191,7 @@ class AdmissionController:
     def deficit(self) -> float:
         """Bucket exhaustion in [0, 1]: 0 = full burst available, 1 = dry.
         One input to the degradation controller's pressure signal."""
-        if self.rate <= 0:
+        if self._rate_now() <= 0:
             return 0.0
         with self._lock:
             self._refill_locked(self.clock())
@@ -196,7 +214,7 @@ class AdmissionController:
         """Charge `units` or raise TooManyRequestsError.  Tokens are repaid
         by time, not by completion — the bucket bounds offered RATE; the
         reservation ledgers bound concurrent FOOTPRINT."""
-        if self.rate <= 0:
+        if self._rate_now() <= 0:
             return
         # a single query costlier than the whole burst must still be servable
         units = min(float(units), self.burst)
@@ -230,7 +248,7 @@ class AdmissionController:
                     waited_ms = (now - start) * 1000
                     if waited_ms >= budget_ms:
                         self._shed(query_id, f"queued {waited_ms:.0f} ms without a token")
-                    need_s = (units - self._tokens) / self.rate
+                    need_s = (units - self._tokens) / max(self._rate_now(), 1e-9)
                     self._lock.wait(timeout=min(need_s, (budget_ms - waited_ms) / 1000))
             finally:
                 self._waiting -= 1
@@ -241,7 +259,7 @@ class AdmissionController:
         `units` only if available right now, never queue, never shed.  Under
         token scarcity this returns False while admit() can still queue —
         exactly the ordering that throttles hedges before primaries."""
-        if self.rate <= 0:
+        if self._rate_now() <= 0:
             return True
         units = min(float(units), self.burst)
         with self._lock:
@@ -255,7 +273,8 @@ class AdmissionController:
         with self._lock:
             self._refill_locked(self.clock())
             return {
-                "rate": self.rate,
+                "rate": self._rate_now(),
+                "staticRate": self.rate,
                 "burst": self.burst,
                 "tokens": round(self._tokens, 3),
                 "waiting": self._waiting,
@@ -642,6 +661,12 @@ class DegradationController:
         for threshold, candidate in self.THRESHOLDS:
             if occupancy >= threshold:
                 lvl = candidate
+        # the autopilot's degrade_level knob is a FLOOR: on sustained SLO
+        # breach the controller can hold the ladder up even when memory
+        # occupancy alone would not (ISSUE 18: breach-driven degradation)
+        from pinot_tpu.cluster import autopilot
+
+        lvl = max(lvl, int(autopilot.knobs().get("degrade_level")))
         with self._lock:
             self._level = lvl
         METRICS.gauge("admission.pressureLevel").set(float(lvl))
@@ -733,6 +758,7 @@ class ResourceGovernor:
                     else None
                 ),
                 max_queue=int(os.environ.get("PINOT_TPU_ADMISSION_QUEUE", "8")),
+                knob="admission_rate",
             )
         if watchdog is None:
             watchdog = QueryWatchdog(

@@ -30,8 +30,12 @@ from pinot_tpu.utils import threads
 
 def batch_wait_ms() -> float:
     """Bounded coalescing window; 0 disables batching (submit runs the
-    query immediately as a singleton group)."""
-    return float(os.environ.get("PINOT_TPU_BATCH_WAIT_MS", "2"))
+    query immediately as a singleton group).  Routed through the autopilot
+    KnobRegistry: the env var is the initial value / clamp anchor, and a
+    registry write takes effect on the next submit without rebuilding."""
+    from pinot_tpu.cluster import autopilot
+
+    return float(autopilot.knobs().get("batch_wait_ms"))
 
 
 def batch_max() -> int:
@@ -73,7 +77,11 @@ class MicroBatcher:
         clock: Optional[Callable[[], float]] = None,
     ):
         self.runner = runner
-        self.wait_ms = batch_wait_ms() if wait_ms is None else float(wait_ms)
+        # None => consult the KnobRegistry per submit (live-tunable);
+        # an explicit ctor value pins the window (tests, embedded uses)
+        self._wait_ms_override: Optional[float] = (
+            None if wait_ms is None else float(wait_ms)
+        )
         self.max_batch = batch_max() if max_batch is None else int(max_batch)
         # injected clock => manual pump() (deterministic tests); the real
         # monotonic clock => lazy daemon worker wakes groups on deadline
@@ -84,6 +92,18 @@ class MicroBatcher:
         self._worker: Optional[Any] = None
         self._closed = False
 
+    @property
+    def wait_ms(self) -> float:
+        """Coalescing window, read per decision (KnobRegistry-backed when
+        not pinned at construction or by direct assignment)."""
+        if self._wait_ms_override is not None:
+            return self._wait_ms_override
+        return batch_wait_ms()
+
+    @wait_ms.setter
+    def wait_ms(self, value: float) -> None:
+        self._wait_ms_override = float(value)
+
     # -- submission ---------------------------------------------------------
 
     def submit(self, key: Hashable, payload: Any) -> Future:
@@ -91,14 +111,15 @@ class MicroBatcher:
         the group inline (in this caller's thread) when it fills to
         max_batch or when the wait window is 0."""
         entry = BatchEntry(payload)
-        if self.wait_ms <= 0 or self.max_batch <= 1:
+        wait_ms = self.wait_ms  # one knob read per decision (coherent)
+        if wait_ms <= 0 or self.max_batch <= 1:
             self._run([entry])
             return entry.future
         full: Optional[List[BatchEntry]] = None
         with self._cv:
             group = self._groups.get(key)
             if group is None:
-                group = _Group(self.clock() + self.wait_ms / 1000.0)
+                group = _Group(self.clock() + wait_ms / 1000.0)
                 self._groups[key] = group
             group.entries.append(entry)
             if len(group.entries) >= self.max_batch:

@@ -354,14 +354,41 @@ class HedgeController:
         self.enabled_default = env.get("PINOT_TPU_HEDGE", "0").lower() in ("1", "true", "yes")
         d = env.get("PINOT_TPU_HEDGE_DELAY_MS")
         self.env_delay_ms: Optional[float] = float(d) if d else None
-        self.budget_pct = float(env.get("PINOT_TPU_HEDGE_BUDGET_PCT", "10"))
+        # budget_pct / quantile_mult read the autopilot KnobRegistry per
+        # decision (env vars are the registry's initial values); a direct
+        # assignment (tests, bench legs) pins the value via the override
+        self._budget_pct_override: Optional[float] = None
+        self._quantile_mult_override: Optional[float] = None
         self.min_samples = int(env.get("PINOT_TPU_HEDGE_MIN_SAMPLES", "8"))
-        self.quantile_mult = float(env.get("PINOT_TPU_HEDGE_QUANTILE_MULT", "1.0"))
         self.min_delay_ms = float(env.get("PINOT_TPU_HEDGE_MIN_DELAY_MS", "1.0"))
         self._lock = threading.Lock()
         self._windows: Dict[Tuple[str, str], deque] = {}
         self._primaries = 0
         self._hedges = 0
+
+    @property
+    def budget_pct(self) -> float:
+        if self._budget_pct_override is not None:
+            return self._budget_pct_override
+        from pinot_tpu.cluster import autopilot
+
+        return float(autopilot.knobs().get("hedge_budget_pct"))
+
+    @budget_pct.setter
+    def budget_pct(self, value: float) -> None:
+        self._budget_pct_override = float(value)
+
+    @property
+    def quantile_mult(self) -> float:
+        if self._quantile_mult_override is not None:
+            return self._quantile_mult_override
+        from pinot_tpu.cluster import autopilot
+
+        return float(autopilot.knobs().get("hedge_delay_mult"))
+
+    @quantile_mult.setter
+    def quantile_mult(self, value: float) -> None:
+        self._quantile_mult_override = float(value)
 
     def enabled(self, opts: Optional[Dict] = None) -> bool:
         if opts is not None and "hedge" in opts:
@@ -538,6 +565,18 @@ class Broker:
         self.batch_clock = None
         self._query_batcher = None
         self._batcher_lock = threading.Lock()
+        # SLO autopilot (cluster/autopilot.py): the feedback controller that
+        # tunes the KnobRegistry the batcher/hedge/admission/engine/residency
+        # paths read per decision.  Off by default — with PINOT_TPU_AUTOPILOT
+        # unset no controller thread exists, no knob override is ever written,
+        # and every consumer reads its env default: pre-autopilot behavior
+        # bit-exactly.  attach_autopilot() wires one explicitly (benches,
+        # tests drive tick() by hand with a fake clock).
+        from pinot_tpu.cluster import autopilot as autopilot_mod
+
+        self.autopilot: Optional[autopilot_mod.Autopilot] = None
+        if autopilot_mod.autopilot_enabled():
+            self.attach_autopilot(start=True)
         # subscribe via the handle so the subscription is RECORDED and
         # re-registered on every newly adopted leader (breaker heal keeps
         # working across a failover)
@@ -579,6 +618,33 @@ class Broker:
         """Leadership view for GET /debug/election: current leader plus
         per-candidate lease/epoch/role state."""
         return self.coordinator.election_snapshot()
+
+    def attach_autopilot(self, controller=None, start: bool = False):
+        """Wire an SLO autopilot to this broker (replacing any previous
+        one).  Default construction feeds it the process PerfLedger and this
+        broker's governor; `start` launches the fixed-tick thread."""
+        from pinot_tpu.cluster import autopilot as autopilot_mod
+
+        old = self.autopilot
+        if old is not None:
+            old.stop()
+        if controller is None:
+            controller = autopilot_mod.Autopilot(governor=self.governor)
+        self.autopilot = controller
+        if start:
+            controller.start()
+        return controller
+
+    def autopilot_snapshot(self) -> Dict:
+        """Knob values vs clamp bounds plus controller state for
+        GET /debug/autopilot + `cli autopilot` — available with the
+        controller detached too (registry-only view)."""
+        from pinot_tpu.cluster import autopilot as autopilot_mod
+
+        ap = self.autopilot
+        if ap is not None:
+            return ap.snapshot()
+        return {"enabled": False, **autopilot_mod.knobs().snapshot()}
 
     # -- routing table (built per query from the external view) -----------
     def _route(

@@ -136,6 +136,15 @@ class QueryServer:
                             from pinot_tpu.utils.perf import PERF_LEDGER
 
                             self._send(200, PERF_LEDGER.snapshot())
+                    elif url.path == "/debug/autopilot":
+                        # SLO autopilot view: knob values vs clamp bounds,
+                        # last N controller decisions with triggering signal,
+                        # per-table SLO state (cluster/autopilot.py)
+                        snap_fn = getattr(outer.engine, "autopilot_snapshot", None)
+                        if snap_fn is None:
+                            self._send(404, {"error": "engine has no autopilot view"})
+                            return
+                        self._send(200, snap_fn())
                     elif url.path == "/debug/election":
                         # coordinator HA view: current leader + per-candidate
                         # lease/epoch/role state (cluster/election.py)

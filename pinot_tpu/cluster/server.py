@@ -33,6 +33,16 @@ from pinot_tpu.utils import perf
 from pinot_tpu.utils.metrics import METRICS, MetricsRegistry
 
 
+def _staging_depth() -> int:
+    """Scatter staging window: how many consecutive segments must be
+    jointly resident while the scan pages through the HBM cache.  Routed
+    through the autopilot KnobRegistry (PINOT_TPU_STAGING_DEPTH initial,
+    default 2 = current segment + the one prefetching behind it)."""
+    from pinot_tpu.cluster import autopilot
+
+    return max(1, int(autopilot.knobs().get("staging_depth")))
+
+
 def _segment_bytes(segment: ImmutableSegment) -> int:
     """Host-array bytes of one segment (codes/values/null masks/MV lengths)
     — the per-table residency the segmentBytes gauge tracks."""
@@ -192,9 +202,11 @@ class ServerInstance:
                 # Working sets that exceed free-but-not-total budget park
                 # as a staged fetch instead of 503ing; a window that
                 # exceeds the whole budget cannot fit even transiently
-                # and still raises ReservationError.
+                # and still raises ReservationError.  The window width is
+                # the autopilot staging_depth knob (read per decision).
+                win = _staging_depth()
                 need = max(
-                    (sum(est[i : i + 2]) for i in range(len(est))), default=0
+                    (sum(est[i : i + win]) for i in range(len(est))), default=0
                 )
                 ticket = self.budget.reserve_or_wait(
                     need, what=f"scatter to server {self.name}", deadline=deadline
@@ -378,8 +390,9 @@ class ServerInstance:
                 # pipeline-window reservation (see execute): the cache
                 # pages segments through the budget, so only the window
                 # must be jointly resident
+                win = _staging_depth()
                 need = max(
-                    (sum(est[i : i + 2]) for i in range(len(est))), default=0
+                    (sum(est[i : i + win]) for i in range(len(est))), default=0
                 )
                 ticket = self.budget.reserve_or_wait(
                     need, what=f"batched scatter to server {self.name}"

@@ -265,11 +265,11 @@ class DistributedEngine:
         # r5 timing_pairs spread exposed); 1 = the old fully-serialized loop.
         # Each in-flight launch holds a capture copy of its batch inputs, so
         # resident HBM scales with depth — _batching sizes batches against
-        # launch_bytes, keeping depth * batch_bytes bounded.
-        self.pipeline_depth = (
-            pipeline_depth
-            if pipeline_depth is not None
-            else int(os.environ.get("PINOT_TPU_PIPELINE_DEPTH", "2"))
+        # launch_bytes, keeping depth * batch_bytes bounded.  None routes
+        # through the autopilot KnobRegistry per launch (env var = initial
+        # value + ceiling); an explicit ctor value or direct assignment pins.
+        self._pipeline_depth_override: Optional[int] = (
+            None if pipeline_depth is None else int(pipeline_depth)
         )
         # tiered segment storage (segment/residency.py): HBM is a byte-
         # budgeted cache over the host arrays.  The staging stream copies
@@ -293,6 +293,21 @@ class DistributedEngine:
             self.residency = None
         else:
             self.residency = default_residency()
+
+    @property
+    def pipeline_depth(self) -> int:
+        """In-flight launch depth, read per launch loop (KnobRegistry-backed
+        unless pinned by the ctor or a direct assignment)."""
+        if self._pipeline_depth_override is not None:
+            return self._pipeline_depth_override
+        # runtime import: autopilot is cluster-layer, engine is parallel-layer
+        from pinot_tpu.cluster import autopilot
+
+        return int(autopilot.knobs().get("pipeline_depth"))
+
+    @pipeline_depth.setter
+    def pipeline_depth(self, value: int) -> None:
+        self._pipeline_depth_override = int(value)
 
     @property
     def num_devices(self) -> int:

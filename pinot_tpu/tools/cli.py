@@ -227,6 +227,64 @@ def cmd_election(args) -> int:
     return 0
 
 
+def cmd_autopilot(args) -> int:
+    """Print a serving endpoint's SLO-autopilot view (GET /debug/autopilot):
+    knob values vs clamp bounds, last N controller decisions with the
+    triggering signal, per-table SLO state, and the knobChanges/ladderWalks
+    counters."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/debug/autopilot"
+    with urllib.request.urlopen(url) as resp:
+        payload = json.loads(resp.read().decode("utf-8"))
+    if args.json:
+        print(json.dumps(payload, indent=2))
+        return 0
+    if payload.get("enabled"):
+        print(
+            f"autopilot : ON  slo={payload.get('sloMs', 0):g} ms "
+            f"tick={payload.get('tickS', 0):g} s ticks={payload.get('ticks', 0)} "
+            f"cooldown={payload.get('cooldown', 0)} "
+            f"running={payload.get('running', False)}"
+        )
+        bound = payload.get("changeBound", {})
+        print(
+            f"changes   : {payload.get('knobChanges', 0)} knob change(s), "
+            f"{payload.get('ladderWalks', 0)} ladder walk(s) "
+            f"(bound {bound.get('maxChanges', '-')}/{bound.get('windowTicks', '-')} ticks)"
+        )
+    else:
+        print("autopilot : OFF (registry view only)")
+    for name, k in sorted(payload.get("knobs", {}).items()):
+        mark = "*" if k.get("overridden") else " "
+        print(
+            f"  {mark}{name:<18} = {k.get('value', 0):g}  "
+            f"[{k.get('lo', 0):g} .. {k.get('hi', 0):g}]  "
+            f"initial={k.get('initial', 0):g} degrade={k.get('degrade')}"
+        )
+    splits = payload.get("splits", {})
+    if splits:
+        shares = " ".join(f"{t}={f:.2f}" for t, f in sorted(splits.items()))
+        print(f"  residency splits: {shares}")
+    for t, st in sorted(payload.get("tables", {}).items()):
+        p99 = st.get("p99_ms")
+        p99s = f"{p99:.1f} ms" if p99 is not None else "-"
+        print(f"  table {t}: {st.get('state', '?')} p99={p99s} qps={st.get('qps', 0):g}")
+    decisions = payload.get("decisions", [])
+    n = max(0, int(getattr(args, "last", 0) or 0)) or 10
+    for d in decisions[-n:]:
+        knob = f" {d.get('knob')}: {d.get('from')} -> {d.get('to')}" if d.get("knob") else ""
+        sig = d.get("signal", {})
+        p99 = sig.get("p99_ms")
+        p99s = f"{p99:.1f}" if p99 is not None else "-"
+        print(
+            f"  tick {d.get('tick'):>4} {d.get('action', ''):<16}{knob}  "
+            f"(p99={p99s} ms qps={sig.get('qps', 0):g})"
+        )
+    print(f"-- {len(decisions)} decision(s) recorded", file=sys.stderr)
+    return 0
+
+
 def cmd_perf(args) -> int:
     """Perf observatory view + bench-regression gate.
 
@@ -460,6 +518,12 @@ def main(argv=None) -> int:
     el.add_argument("--url", default="http://127.0.0.1:8099", help="query server base URL")
     el.add_argument("--json", action="store_true", help="dump the raw snapshot as JSON")
     el.set_defaults(fn=cmd_election)
+
+    ap = sub.add_parser("autopilot", help="print a serving endpoint's SLO-autopilot state")
+    ap.add_argument("--url", default="http://127.0.0.1:8099", help="query server base URL")
+    ap.add_argument("--last", type=int, default=10, help="controller decisions to print")
+    ap.add_argument("--json", action="store_true", help="dump the raw snapshot as JSON")
+    ap.set_defaults(fn=cmd_autopilot)
 
     pf = sub.add_parser("perf", help="perf ledger view + bench-regression gate")
     pf.add_argument("--url", default="http://127.0.0.1:8099", help="query server base URL")

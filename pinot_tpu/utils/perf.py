@@ -278,12 +278,16 @@ class _LedgerEntry:
 
 def _win_stats(dq: Deque[float]) -> Dict[str, float]:
     if not dq:
-        return {"last": 0.0, "mean": 0.0, "max": 0.0}
+        return {"last": 0.0, "mean": 0.0, "max": 0.0, "p99": 0.0}
     vals = list(dq)
+    ordered = sorted(vals)
+    p99 = ordered[min(len(ordered) - 1, int(round(0.99 * (len(ordered) - 1))))]
     return {
         "last": round(vals[-1], 3),
         "mean": round(sum(vals) / len(vals), 3),
         "max": round(max(vals), 3),
+        # windowed tail: the autopilot's primary feedback signal
+        "p99": round(p99, 3),
     }
 
 
@@ -436,7 +440,14 @@ GATE_METRICS: Tuple[str, ...] = (
 # failover_blackout_ms is the HA drill's control-plane blackout in SIM time
 # (lease expiry + standby replay-to-tip + handle adoption): the election
 # protocol's cost, which a regression in lease/fence/promote code inflates.
-GATE_METRICS_LOWER: Tuple[str, ...] = ("hedged_p99_ms", "failover_blackout_ms")
+# autopilot_admitted_p99_ms is the autopilot_overload bench's admitted-p99
+# at 3x offered load under a seeded gray fault with the closed loop driving
+# the knobs — the adaptive-serving layer's headline number.
+GATE_METRICS_LOWER: Tuple[str, ...] = (
+    "hedged_p99_ms",
+    "failover_blackout_ms",
+    "autopilot_admitted_p99_ms",
+)
 
 # Allowance bounds: at least 15% slack (CI-grade CPU runs are noisy even
 # with bench.py's median-of-pairs machinery), never 20%+ — the acceptance
@@ -458,6 +469,7 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
     ws = report.get("working_set_sweep", {}) or {}
     fo = report.get("failover", {}) or {}
     ms = report.get("mesh_scaling", {}) or {}
+    ap = report.get("autopilot_overload", {}) or {}
     return {
         "schema": 1,
         "bench": bench,
@@ -501,6 +513,11 @@ def bench_record(report: Dict[str, Any], *, bench: str = "ssb_groupby") -> Dict[
             "mesh_2x4_rows_per_sec": ((ms.get("topologies", {}) or {}).get("2x4", {}) or {}).get(
                 "rows_per_sec"
             ),
+            "autopilot_admitted_p99_ms": (ap.get("autopilot", {}) or {}).get(
+                "admitted_p99_ms"
+            ),
+            "autopilot_vs_best_static": ap.get("autopilot_vs_best_static"),
+            "autopilot_knob_changes": (ap.get("autopilot", {}) or {}).get("knob_changes"),
         },
         "noise": {"run_variance": report.get("run_variance", 0.0)},
     }

@@ -48,6 +48,18 @@ def _reset_metrics():
 
 
 @pytest.fixture(autouse=True)
+def _reset_knob_registry():
+    """The autopilot KnobRegistry is process-global; a knob override set by
+    one test (or a controller it started) must not leak into the env-default
+    reads every other test depends on."""
+    from pinot_tpu.cluster import autopilot
+
+    autopilot.reset_knobs()
+    yield
+    autopilot.reset_knobs()
+
+
+@pytest.fixture(autouse=True)
 def _reset_thread_provider():
     """The primitive provider (utils/threads.py) is process-global; a test
     that dies inside a model-checker schedule must not leave the

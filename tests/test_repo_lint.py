@@ -1096,6 +1096,73 @@ class TestW025BareAxisLiteralInCollective:
         assert out == []
 
 
+class TestW026ControllerDiscipline:
+    def test_flags_direct_knob_write_outside_setter(self):
+        # runtime knob mutation skipping the clamped registry setter
+        src = """
+        class Adaptor:
+            def react(self, hc):
+                hc.budget_pct = 60.0
+        """
+        assert _rules(src) == ["W026"]
+
+    def test_flags_augassign_on_managed_knob(self):
+        src = """
+        def widen(batcher):
+            batcher.wait_ms += 1.0
+        """
+        assert _rules(src) == ["W026"]
+
+    def test_flags_wall_clock_inside_autopilot_module(self):
+        src = """
+        import time
+
+        class Autopilot:
+            def tick(self):
+                return time.monotonic()
+        """
+        out = lint_source(textwrap.dedent(src), path="cluster/autopilot.py")
+        assert [f.rule for f in out] == ["W026"]
+
+    def test_quiet_on_init_wiring_and_property_setter(self):
+        # construction wires defaults; the property setter IS the sanctioned
+        # pin-the-override path (stores an underscore override)
+        src = """
+        class MicroBatcher:
+            def __init__(self, wait_ms):
+                self.wait_ms = wait_ms
+
+            @wait_ms.setter
+            def wait_ms(self, value):
+                self._wait_ms_override = float(value)
+        """
+        assert _rules(src) == []
+
+    def test_quiet_on_injected_clock_in_autopilot_module(self):
+        # threads.monotonic is the injection seam, self.clock() the fake —
+        # neither is the wall clock
+        src = """
+        from pinot_tpu.utils import threads
+
+        class Autopilot:
+            def tick(self):
+                return self.clock() + threads.monotonic()
+        """
+        out = lint_source(textwrap.dedent(src), path="cluster/autopilot.py")
+        assert out == []
+
+    def test_quiet_on_wall_clock_outside_autopilot_module(self):
+        # the wall-clock half of W026 is scoped to autopilot modules (other
+        # wall-clock misuse belongs to W005/W017/W022)
+        src = """
+        import time
+
+        def stamp():
+            return time.monotonic()
+        """
+        assert _rules(src) == []
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = lint_source("def broken(:\n", path="x.py")
     assert len(out) == 1 and out[0].rule == "E000"
